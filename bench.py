@@ -1,0 +1,62 @@
+"""Benchmark driver: single-chip radix join throughput on real TPU.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Workload: the reference's canonical per-node join scaled to one chip —
+16M ⋈ 16M dense unique uint32 keys (BASELINE.md config #2; the reference runs
+20M ⋈ 20M per node, main.cpp:70-71).  Correctness is asserted against the
+unique-key oracle before timing.
+
+vs_baseline: the reference publishes no numbers (BASELINE.md — published {}),
+so the denominator is 1e9 tuples/sec/accelerator, a nominal figure for the
+reference-era GPU build/probe kernels (sm_60-class, eth.cu) on this workload;
+vs_baseline >= 1.0 therefore means beating reference-class per-accelerator
+throughput.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.ops.local_join import local_join_partitioned
+
+    size = 1 << 24               # 16M tuples per side
+    fanout_bits = 7              # 128 partitions
+    capacity = (size >> fanout_bits) * 2
+
+    r_rel = Relation(size, 1, "unique", seed=1)
+    s_rel = Relation(size, 1, "unique", seed=2)
+    r = jax.block_until_ready(r_rel.shard(0))
+    s = jax.block_until_ready(s_rel.shard(0))
+
+    counts, overflow = local_join_partitioned(r, s, fanout_bits, capacity)
+    matches = int(np.asarray(counts).astype(np.uint64).sum())
+    assert int(overflow) == 0, "partition capacity overflow"
+    assert matches == size, (matches, size)
+
+    # steady-state timing (compile already cached by the correctness run)
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        counts, overflow = local_join_partitioned(r, s, fanout_bits, capacity)
+    jax.block_until_ready((counts, overflow))
+    dt = (time.perf_counter() - t0) / iters
+
+    tuples_per_sec = (2 * size) / dt   # both relations processed
+    print(json.dumps({
+        "metric": "single_chip_join_throughput",
+        "value": round(tuples_per_sec, 1),
+        "unit": "tuples/sec",
+        "vs_baseline": round(tuples_per_sec / 1e9, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

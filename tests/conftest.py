@@ -1,0 +1,23 @@
+"""Test rig: single-process multi-device CPU mesh.
+
+The reference tests multi-node behavior with plain oversubscribed ``mpirun``
+(SURVEY.md §4.5); the JAX analog is 8 virtual CPU devices via
+``--xla_force_host_platform_device_count``.
+
+The container's sitecustomize imports jax at interpreter start with
+``JAX_PLATFORMS=axon`` (the live-TPU tunnel), which locks the config default
+before this file runs — so we must update jax.config directly, not just the
+environment.  XLA_FLAGS is still read at first backend use, which has not
+happened yet at conftest import time.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,48 @@
+import numpy as np
+
+from tpu_radix_join.data.relation import (
+    Relation,
+    host_join_count,
+    unique_keys_device,
+)
+
+
+def test_unique_is_permutation():
+    rel = Relation(global_size=4096, num_nodes=4, kind="unique", seed=7)
+    keys = np.concatenate([rel.shard_np(i)[0] for i in range(4)])
+    np.testing.assert_array_equal(np.sort(keys), np.arange(4096))
+
+
+def test_unique_device_matches_host():
+    rel = Relation(global_size=1 << 12, num_nodes=2, kind="unique", seed=11)
+    for node in range(2):
+        host_keys, _ = rel.shard_np(node)
+        dev_keys = np.asarray(rel.shard(node).key)
+        np.testing.assert_array_equal(dev_keys, host_keys)
+
+
+def test_unique_non_pow2_domain():
+    rel = Relation(global_size=3000, num_nodes=3, kind="unique", seed=3)
+    keys = np.concatenate([rel.shard_np(i)[0] for i in range(3)])
+    np.testing.assert_array_equal(np.sort(keys), np.arange(3000))
+    dev = np.concatenate([np.asarray(rel.shard(i).key) for i in range(3)])
+    np.testing.assert_array_equal(dev, keys)
+
+
+def test_modulo_and_oracles():
+    r = Relation(global_size=1024, kind="unique", seed=5)
+    s_uni = Relation(global_size=1024, kind="unique", seed=9)
+    s_mod = Relation(global_size=2048, kind="modulo", modulo=256)
+    assert r.expected_matches(s_uni) == 1024
+    assert r.expected_matches(s_mod) == 2048
+    # cross-check with the host join oracle
+    rk = r.shard_np(0)[0]
+    np.testing.assert_equal(host_join_count(rk, s_mod.shard_np(0)[0]), 2048)
+
+
+def test_zipf_within_domain():
+    s = Relation(global_size=1000, kind="zipf", zipf_theta=0.75, key_domain=500)
+    keys, _ = s.shard_np(0)
+    assert keys.max() < 500
+    r = Relation(global_size=500, kind="unique")
+    assert r.expected_matches(s) == 1000

@@ -1,0 +1,57 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data import tuples as T
+
+
+def _batch(keys, rids, hi=None):
+    return T.TupleBatch(
+        key=jnp.asarray(keys, jnp.uint32),
+        rid=jnp.asarray(rids, jnp.uint32),
+        key_hi=None if hi is None else jnp.asarray(hi, jnp.uint32),
+    )
+
+
+def test_partition_ids_low_bits():
+    b = _batch([0, 1, 31, 32, 33, 255], [0, 1, 2, 3, 4, 5])
+    pid = T.partition_ids(b, 5)
+    np.testing.assert_array_equal(np.asarray(pid), [0, 1, 31, 0, 1, 31])
+
+
+def test_compress_roundtrip_32():
+    rng = np.random.default_rng(0)
+    keys = rng.integers(0, 1 << 30, 1000, dtype=np.uint32)
+    rids = np.arange(1000, dtype=np.uint32)
+    b = _batch(keys, rids)
+    for f in (0, 5, 8):
+        pid = T.partition_ids(b, f)
+        c = T.compress(b, f)
+        back = T.decompress(c, pid, f)
+        np.testing.assert_array_equal(np.asarray(back.key), keys)
+        np.testing.assert_array_equal(np.asarray(back.rid), rids)
+
+
+def test_compress_roundtrip_64():
+    rng = np.random.default_rng(1)
+    lo = rng.integers(0, 1 << 32, 500, dtype=np.uint64).astype(np.uint32)
+    hi = rng.integers(0, 1 << 20, 500, dtype=np.uint64).astype(np.uint32)
+    b = _batch(lo, np.arange(500), hi)
+    for f in (0, 5):
+        pid = T.partition_ids(b, f)
+        c = T.compress(b, f)
+        back = T.decompress(c, pid, f)
+        np.testing.assert_array_equal(np.asarray(back.key), lo)
+        np.testing.assert_array_equal(np.asarray(back.key_hi), hi)
+
+
+def test_padding_and_masks():
+    pad_r = T.make_padding(16, "inner")
+    pad_s = T.make_padding(16, "outer")
+    assert not bool(T.valid_mask(pad_r, "inner").any())
+    assert not bool(T.valid_mask(pad_s, "outer").any())
+    # inner sentinel never equals outer sentinel
+    assert T.R_PAD_KEY != T.S_PAD_KEY
+    b = _batch([1, 2], [3, 4])
+    full = T.make_padding_like(b, 4, "inner")
+    assert full.key.shape == (4,)
+    assert bool((full.key == T.R_PAD_KEY).all())

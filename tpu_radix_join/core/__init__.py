@@ -1,0 +1,3 @@
+from tpu_radix_join.core.config import JoinConfig
+
+__all__ = ["JoinConfig"]

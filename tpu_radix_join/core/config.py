@@ -1,0 +1,151 @@
+"""Typed runtime configuration.
+
+TPU-native replacement for the reference's compile-time constant header
+(``core/Configuration.h:15-40``) plus its CMake ``-D`` switches
+(``CMakeLists.txt:10-15``): one frozen dataclass whose derived quantities
+(partition counts, packing layout, padded shuffle capacities) are computed
+properties, so the relationships the reference spreads across four files
+(``NetworkPartitioning.cpp:128-129``, ``LocalPartitioning.cpp:147-153``,
+``BuildProbe.cpp:55-61``, ``GPUWrapper.cu:39-41``) live in one place.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Tuple
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinConfig:
+    """All knobs of the join pipeline.
+
+    The reference equivalents:
+      * ``network_fanout_bits``  -> ``NETWORK_PARTITIONING_FANOUT`` (Configuration.h:30)
+      * ``local_fanout_bits``    -> ``LOCAL_PARTITIONING_FANOUT`` (Configuration.h:31)
+      * ``payload_bits``         -> ``PAYLOAD_BITS`` (Configuration.h:38)
+      * ``two_level``            -> ``ENABLE_TWO_LEVEL_PARTITIONING`` (Configuration.h:28)
+      * ``allocation_factor``    -> ``ALLOCATION_FACTOR`` (Configuration.h:36); here it is
+        the slack on the statically-shaped per-destination shuffle blocks rather than on
+        a malloc'd pool, because XLA requires static shapes (SURVEY.md 7.2).
+      * ``result_aggregation_node`` -> ``RESULT_AGGREGATION_NODE`` (Configuration.h:19)
+      * ``assignment_policy``    -> AssignmentMap policy (AssignmentMap.cpp:41-43 is
+        round-robin; "load_aware" realises the skew-aware API shape its ctor promises).
+      * ``probe_algorithm``      -> selects among the BuildProbe / GPU probe-kernel
+        families (BuildProbe.cpp chained table; kernels.cu probe / probe_count).
+    """
+
+    # --- partitioning geometry -------------------------------------------------
+    network_fanout_bits: int = 5
+    local_fanout_bits: int = 5
+    two_level: bool = False
+
+    # --- tuple layout ----------------------------------------------------------
+    key_bits: int = 32           # 32 -> single uint32 key lane; 64 -> hi/lo lanes
+    payload_bits: int = 27       # rid width contract (Configuration.h:38)
+
+    # --- distribution ----------------------------------------------------------
+    num_nodes: int = 1           # mesh size along the "nodes" axis
+    mesh_axis: str = "nodes"
+    result_aggregation_node: int = 0
+
+    # --- shuffle data plane (Window) ------------------------------------------
+    # "measured": run the histogram phase as its own program and compile the
+    #   shuffle at the exact (pow2-rounded) worst-case block demand — the
+    #   analog of the reference's runtime-sized windows (Window.cpp:168-177).
+    # "static": skip the sizing pre-pass; capacity = local_size / N *
+    #   allocation_factor (cheaper, can overflow under skew; overflow flips ok).
+    window_sizing: str = "measured"
+    allocation_factor: float = 1.5   # slack multiplier on padded blocks (static
+                                     # window sizing + local bucket capacities)
+
+    # --- policies --------------------------------------------------------------
+    assignment_policy: str = "round_robin"   # or "load_aware"
+    probe_algorithm: str = "sort"            # "sort" | "bucket"
+    match_rate_cap: int = 8                  # max materialized matches per outer tuple
+    chunk_size: Optional[int] = None         # out-of-core probe chunking (LD kernels)
+
+    # --- instrumentation -------------------------------------------------------
+    debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
+
+    def __post_init__(self):
+        if self.network_fanout_bits < 0 or self.local_fanout_bits < 0:
+            raise ValueError("fanout bits must be non-negative")
+        if self.key_bits not in (32, 64):
+            raise ValueError("key_bits must be 32 or 64")
+        if self.num_nodes < 1:
+            raise ValueError("num_nodes must be >= 1")
+        if self.assignment_policy not in ("round_robin", "load_aware"):
+            raise ValueError(f"unknown assignment policy {self.assignment_policy!r}")
+        if self.probe_algorithm not in ("sort", "bucket"):
+            raise ValueError(f"unknown probe algorithm {self.probe_algorithm!r}")
+        if self.allocation_factor < 1.0:
+            raise ValueError("allocation_factor must be >= 1.0")
+        if self.window_sizing not in ("measured", "static"):
+            raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
+
+    # --- derived geometry ------------------------------------------------------
+    @property
+    def network_partition_count(self) -> int:
+        """NETWORK_PARTITIONING_COUNT = 1 << FANOUT (Configuration.h:33)."""
+        return 1 << self.network_fanout_bits
+
+    @property
+    def local_partition_count(self) -> int:
+        """LOCAL_PARTITIONING_COUNT = 1 << FANOUT (Configuration.h:34)."""
+        return 1 << self.local_fanout_bits
+
+    @property
+    def total_fanout_bits(self) -> int:
+        return self.network_fanout_bits + (self.local_fanout_bits if self.two_level else 0)
+
+    @property
+    def total_partition_count(self) -> int:
+        return 1 << self.total_fanout_bits
+
+    def shuffle_block_capacity(self, local_size: int) -> int:
+        """Static per-destination block size for the all_to_all shuffle.
+
+        The reference sizes each rank's RMA window exactly from the global
+        histogram (Window.cpp:168-177); XLA needs the shape before the data
+        exists, so we take the expected per-destination share with
+        ``allocation_factor`` slack, rounded up to a multiple of 8 lanes.
+        Overflow is detected at runtime (Window.assert_all_tuples_written).
+        """
+        n = max(1, self.num_nodes)
+        cap = int(math.ceil(local_size / n * self.allocation_factor))
+        return max(8, -(-cap // 8) * 8)
+
+    def bucket_capacity(self, total_slots: int, num_buckets: int) -> int:
+        """Static per-bucket capacity for the local partitioning pass: expected
+        share of ``total_slots`` with ``allocation_factor`` slack (the analog
+        of LocalPartitioning's cacheline-padded sub-partition sizing,
+        LocalPartitioning.cpp:178-181)."""
+        cap = int(math.ceil(total_slots / max(1, num_buckets) * self.allocation_factor))
+        return max(8, -(-cap // 8) * 8)
+
+    # --- key/rid packing contract ---------------------------------------------
+    @property
+    def key_remainder_bits(self) -> int:
+        """Key bits that survive compression (partition bits are implied by
+        partition membership — NetworkPartitioning.cpp:128-129)."""
+        return self.key_bits - self.network_fanout_bits
+
+    @property
+    def probe_shift_bits(self) -> int:
+        """Bits below the probe-comparison key remainder: the analog of
+        ``shiftBits = 5 + 27 (+5)`` in BuildProbe.cpp:55-61 / GPUWrapper.cu:39-41.
+        In the SoA layout the rid lives in its own lane, so only fanout bits
+        shift out of the key lane."""
+        return self.total_fanout_bits
+
+    def bucket_count_for(self, inner_size: int) -> int:
+        """N = next power of two >= inner partition size (BuildProbe.cpp:59-61)."""
+        return _next_pow2(max(1, inner_size))
+
+    def replace(self, **kw) -> "JoinConfig":
+        return dataclasses.replace(self, **kw)

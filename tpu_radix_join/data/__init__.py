@@ -1,0 +1,4 @@
+from tpu_radix_join.data.tuples import TupleBatch, CompressedBatch
+from tpu_radix_join.data.relation import Relation
+
+__all__ = ["TupleBatch", "CompressedBatch", "Relation"]

@@ -1,0 +1,202 @@
+"""Relations and seeded data generation with closed-form correctness oracles.
+
+Replaces ``data/Relation.{h,cpp}``:
+
+  * ``fill_unique``  -> ``Relation::fillUniqueValues`` (Relation.cpp:63-73,87-97):
+    every key in ``0..global_size-1`` appears exactly once across all shards, so
+    the exact expected match count of R ⋈ S (both unique over the same range) is
+    ``global_size`` — the oracle the reference checks manually via the
+    ``[RESULTS] Tuples:`` line (Measurements.cpp:599-606, main.cpp:94-98).
+  * ``fill_modulo``  -> ``Relation::fillModuloValues`` (Relation.cpp:75-85):
+    key = rid % modulo, giving closed-form match-rate control.
+  * ``fill_zipf``    -> the Zipf ``zFactor`` capability of the GPU data model
+    (data/data.hpp:88) exercised by the skew benchmark config.
+  * ``Relation.distribute`` -> ``Relation::distribute`` (Relation.cpp:99-141):
+    the reference pairwise-exchanges random blocks so each rank holds a random
+    slice of the key space; here the generator IS globally shuffled (a seeded
+    permutation sharded contiguously), which yields the identical distribution
+    without a network step.
+
+TPU-first scale path: host-side ``np.random.permutation`` caps out around a
+few hundred million tuples, so ``fill_unique`` can also run **on device** via a
+seeded Feistel-network bijection over the key domain with vectorized
+cycle-walking (``feistel_permutation``) — each shard computes its own slice of
+the global permutation with no host materialization (SURVEY.md §7.4 item 5).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.tuples import TupleBatch
+
+_FEISTEL_ROUNDS = 6
+
+
+def _feistel_round_np(l, r, k, half_bits):
+    mask = (1 << half_bits) - 1
+    # Simple multiplicative hash round function (xxhash-style constants).
+    f = ((r * 0x9E3779B1 + k) ^ (r >> 7)) & mask
+    return r, (l ^ f) & mask
+
+
+def feistel_permutation_np(idx: np.ndarray, domain_bits: int, seed: int) -> np.ndarray:
+    """Seeded bijection on [0, 2**domain_bits) — numpy reference implementation."""
+    half = (domain_bits + 1) // 2
+    mask = (1 << half) - 1
+    l = (idx >> half).astype(np.uint64)
+    r = (idx & mask).astype(np.uint64)
+    keys = np.random.default_rng(seed).integers(0, 1 << 31, size=_FEISTEL_ROUNDS, dtype=np.uint64)
+    for i in range(_FEISTEL_ROUNDS):
+        l, r = _feistel_round_np(l, r, keys[i], half)
+    out = (l << half) | r
+    return out & ((1 << (2 * half)) - 1)
+
+
+def _feistel_keys(seed: int) -> np.ndarray:
+    return np.random.default_rng(seed).integers(0, 1 << 31, size=_FEISTEL_ROUNDS, dtype=np.uint32)
+
+
+@functools.partial(jax.jit, static_argnames=("domain_bits",))
+def _feistel_jax(idx: jnp.ndarray, round_keys: jnp.ndarray, domain_bits: int) -> jnp.ndarray:
+    half = (domain_bits + 1) // 2
+    mask = jnp.uint32((1 << half) - 1)
+    l = (idx >> half).astype(jnp.uint32)
+    r = (idx & mask).astype(jnp.uint32)
+    for i in range(_FEISTEL_ROUNDS):
+        f = ((r * jnp.uint32(0x9E3779B1) + round_keys[i]) ^ (r >> 7)) & mask
+        l, r = r, (l ^ f) & mask
+    return (l.astype(jnp.uint32) << half) | r
+
+
+def unique_keys_device(start: int, count: int, global_size: int, seed: int) -> jnp.ndarray:
+    """Shard [start, start+count) of a seeded permutation of [0, global_size),
+    computed entirely on device via Feistel + cycle-walking.
+
+    Requires domain 2**b >= global_size; indices mapping outside
+    [0, global_size) are re-walked until they land inside (expected <= 2 steps
+    since the pow2 domain is < 2x the target)."""
+    domain_bits = max(2, (global_size - 1).bit_length())
+    rk = jnp.asarray(_feistel_keys(seed))
+    idx = (jnp.arange(count, dtype=jnp.uint32) + jnp.uint32(start))
+
+    def body(v):
+        out = _feistel_jax(v, rk, domain_bits)
+        return jnp.where(v < global_size, v, out)  # only walk still-outside values
+
+    def cond(v):
+        return jnp.any(v >= global_size)
+
+    v = _feistel_jax(idx, rk, domain_bits)
+    v = jax.lax.while_loop(cond, body, v)
+    return v
+
+
+class Relation:
+    """A logical relation: a global keyspace spec + per-shard generators.
+
+    The reference's ``Relation`` owns one rank's tuple shard backed by ``Pool``
+    memory (Relation.cpp:26-37); here the object is a *spec* and ``shard_np`` /
+    ``shard`` materialize a given node's slice (host numpy / device jax).
+    ``rid`` is the global tuple index, as in the reference where rid is dense
+    (Relation.cpp:63-73).
+    """
+
+    def __init__(
+        self,
+        global_size: int,
+        num_nodes: int = 1,
+        kind: str = "unique",
+        seed: int = 1234,
+        key_bits: int = 32,
+        modulo: Optional[int] = None,
+        zipf_theta: Optional[float] = None,
+        key_domain: Optional[int] = None,
+    ):
+        if global_size % num_nodes != 0:
+            raise ValueError("global_size must divide evenly across nodes")
+        if kind not in ("unique", "modulo", "zipf"):
+            raise ValueError(f"unknown relation kind {kind!r}")
+        if kind == "modulo" and not modulo:
+            raise ValueError("modulo kind requires modulo=")
+        if kind == "zipf" and zipf_theta is None:
+            raise ValueError("zipf kind requires zipf_theta=")
+        if key_bits == 32 and global_size > (1 << 31):
+            raise ValueError("32-bit keys cap global_size at 2**31 (sentinel headroom)")
+        self.global_size = int(global_size)
+        self.num_nodes = int(num_nodes)
+        self.kind = kind
+        self.seed = int(seed)
+        self.key_bits = int(key_bits)
+        self.modulo = modulo
+        self.zipf_theta = zipf_theta
+        self.key_domain = int(key_domain) if key_domain else self.global_size
+
+    @property
+    def local_size(self) -> int:
+        return self.global_size // self.num_nodes
+
+    # ------------------------------------------------------------------ host
+    def shard_np(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, rids) as numpy uint32 arrays for one node's shard."""
+        lo = node * self.local_size
+        hi = lo + self.local_size
+        rid = np.arange(lo, hi, dtype=np.uint32)
+        if self.kind == "unique":
+            idx = np.arange(lo, hi, dtype=np.uint64)
+            domain_bits = max(2, (self.global_size - 1).bit_length())
+            key = feistel_permutation_np(idx, domain_bits, self.seed)
+            while (key >= self.global_size).any():
+                out = key >= self.global_size
+                key[out] = feistel_permutation_np(key[out], domain_bits, self.seed)
+            return key.astype(np.uint32), rid
+        if self.kind == "modulo":
+            return (rid % np.uint32(self.modulo)).astype(np.uint32), rid
+        # zipf: skewed draw over [0, key_domain)
+        rng = np.random.default_rng(self.seed + node)
+        ranks = rng.zipf(max(1.0001, 1.0 + self.zipf_theta), size=self.local_size)
+        key = ((ranks - 1) % self.key_domain).astype(np.uint32)
+        return key, rid
+
+    # ---------------------------------------------------------------- device
+    def shard(self, node: int) -> TupleBatch:
+        """One node's shard as a device TupleBatch (generation on device for
+        the unique kind; host fallback otherwise)."""
+        lo = node * self.local_size
+        rid = jnp.arange(lo, lo + self.local_size, dtype=jnp.uint32)
+        if self.kind == "unique":
+            key = unique_keys_device(lo, self.local_size, self.global_size, self.seed)
+            return TupleBatch(key=key, rid=rid)
+        key_np, rid_np = self.shard_np(node)
+        return TupleBatch(key=jnp.asarray(key_np), rid=jnp.asarray(rid_np))
+
+    # ---------------------------------------------------------------- oracle
+    def expected_matches(self, outer: "Relation") -> Optional[int]:
+        """Closed-form expected |self ⋈ outer| where derivable (SURVEY.md §4.1).
+
+        unique ⋈ unique over the same range -> global_size (the reference's
+        oracle, main.cpp:95-98); unique ⋈ modulo/zipf with outer key domain
+        covered by the unique range -> outer.global_size.  Returns None when no
+        closed form applies (caller should fall back to a host join)."""
+        if self.kind != "unique":
+            return None
+        if outer.kind == "unique" and outer.global_size == self.global_size:
+            return self.global_size
+        if outer.kind == "modulo" and outer.modulo <= self.global_size:
+            return outer.global_size
+        if outer.kind == "zipf" and outer.key_domain <= self.global_size:
+            return outer.global_size
+        return None
+
+
+def host_join_count(r_keys: np.ndarray, s_keys: np.ndarray) -> int:
+    """O((n+m) log) host oracle join count for tests without a closed form."""
+    r_sorted = np.sort(r_keys)
+    lo = np.searchsorted(r_sorted, s_keys, side="left")
+    hi = np.searchsorted(r_sorted, s_keys, side="right")
+    return int((hi - lo).sum())

@@ -1,0 +1,148 @@
+"""Tuple layout: the TPU-native data model.
+
+Replaces the reference's POD types (``data/Tuple.h:19-20`` — ``{uint64 key;
+uint64 rid}`` — and ``data/CompressedTuple.h:18`` — one packed ``uint64``).
+
+TPU-first design: int64 arithmetic is slow/limited on TPU, so tuples are
+structure-of-arrays batches of uint32 lanes instead of packed scalars:
+
+  * ``TupleBatch``      — full tuples: ``key`` (low 32 key bits), optional
+    ``key_hi`` (upper 32 bits when ``key_bits == 64``), ``rid``.
+  * ``CompressedBatch`` — the shuffle wire format.  The reference compresses
+    16B -> 8B by dropping the partition bits from the key and packing
+    ``value = rid | (key >> FANOUT) << (FANOUT + PAYLOAD_BITS)``
+    (``NetworkPartitioning.cpp:128-129``).  We keep the same information
+    contract — the partition bits are implied by partition membership and
+    reconstructed on unpack — as uint32 lanes: 2 lanes (8B/tuple) for 32-bit
+    keys, matching the reference's 8B CompressedTuple on the wire.
+
+Padding sentinels: statically-shaped shuffle blocks carry invalid slots.  A
+slot is invalid iff its key lane(s) equal the side's sentinel; inner (R) and
+outer (S) sentinels differ so padding can never produce a match.  Real keys
+must therefore stay below ``0xFFFFFFFE`` in the top lane (enforced by the
+generators in ``relation.py``).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+# Sentinel key values for padded (invalid) slots, per relation side.
+R_PAD_KEY = np.uint32(0xFFFFFFFE)   # inner/build side
+S_PAD_KEY = np.uint32(0xFFFFFFFF)   # outer/probe side
+PAD_RID = np.uint32(0xFFFFFFFF)
+
+
+class TupleBatch(NamedTuple):
+    """SoA batch of full tuples (analog of ``Tuple[]``, data/Tuple.h)."""
+
+    key: jnp.ndarray                 # uint32 [n] — low 32 key bits
+    rid: jnp.ndarray                 # uint32 [n]
+    key_hi: Optional[jnp.ndarray] = None   # uint32 [n] when key_bits == 64
+
+    @property
+    def size(self) -> int:
+        return self.key.shape[-1]
+
+
+class CompressedBatch(NamedTuple):
+    """SoA batch of compressed tuples (analog of ``CompressedTuple[]``).
+
+    ``key_rem`` holds ``key >> network_fanout_bits`` (the surviving key bits,
+    BuildProbe.cpp:98-106 compares exactly these); ``key_rem_hi`` the upper
+    lane for 64-bit keys.
+    """
+
+    key_rem: jnp.ndarray             # uint32 [n]
+    rid: jnp.ndarray                 # uint32 [n]
+    key_rem_hi: Optional[jnp.ndarray] = None
+
+    @property
+    def size(self) -> int:
+        return self.key_rem.shape[-1]
+
+
+def partition_ids(batch: TupleBatch, fanout_bits: int) -> jnp.ndarray:
+    """Radix partition id = low ``fanout_bits`` of the key.
+
+    The reference's ``HASH_BIT_MODULO(key, mask, 0)`` (LocalHistogram.cpp:20,
+    44-47).  Returns uint32 [n] in [0, 1 << fanout_bits).
+    """
+    mask = jnp.uint32((1 << fanout_bits) - 1)
+    return batch.key & mask
+
+
+def compress(batch: TupleBatch, fanout_bits: int) -> CompressedBatch:
+    """Drop the partition bits from the key (NetworkPartitioning.cpp:128-129).
+
+    The dropped bits are implied by which partition the tuple is routed to and
+    are restored by :func:`decompress`.
+    """
+    f = jnp.uint32(fanout_bits)
+    if batch.key_hi is None:
+        return CompressedBatch(key_rem=batch.key >> f, rid=batch.rid)
+    if fanout_bits == 0:
+        return CompressedBatch(batch.key, batch.rid, batch.key_hi)
+    lo = (batch.key >> f) | (batch.key_hi << jnp.uint32(32 - fanout_bits))
+    hi = batch.key_hi >> f
+    return CompressedBatch(key_rem=lo, rid=batch.rid, key_rem_hi=hi)
+
+
+def decompress(comp: CompressedBatch, pid: jnp.ndarray, fanout_bits: int) -> TupleBatch:
+    """Reconstruct full keys from remainder + partition id (inverse of compress)."""
+    f = jnp.uint32(fanout_bits)
+    if comp.key_rem_hi is None:
+        return TupleBatch(key=(comp.key_rem << f) | pid.astype(jnp.uint32), rid=comp.rid)
+    if fanout_bits == 0:
+        return TupleBatch(comp.key_rem, comp.rid, comp.key_rem_hi)
+    lo = (comp.key_rem << f) | pid.astype(jnp.uint32)
+    hi = (comp.key_rem_hi << f) | (comp.key_rem >> jnp.uint32(32 - fanout_bits))
+    return TupleBatch(key=lo, rid=comp.rid, key_hi=hi)
+
+
+def probe_key(comp: CompressedBatch) -> jnp.ndarray:
+    """The key material compared during probe (``value >> keyShift``,
+    BuildProbe.cpp:98-106).  For 64-bit keys returns a [n, 2] (hi, lo) stack
+    ordered so lexicographic comparison equals numeric comparison."""
+    if comp.key_rem_hi is None:
+        return comp.key_rem
+    return jnp.stack([comp.key_rem_hi, comp.key_rem], axis=-1)
+
+
+def pad_sentinel(side: str) -> np.uint32:
+    if side == "inner":
+        return R_PAD_KEY
+    if side == "outer":
+        return S_PAD_KEY
+    raise ValueError(f"side must be 'inner' or 'outer', got {side!r}")
+
+
+# TupleBatch and CompressedBatch share a positional layout:
+# field 0 = primary key lane, field 1 = rid, field 2 = optional high key lane.
+def _sentinel_lane(batch) -> jnp.ndarray:
+    return batch[2] if batch[2] is not None else batch[0]
+
+
+def valid_mask(batch, side: str) -> jnp.ndarray:
+    """True for real tuples, False for padding slots (either batch type)."""
+    return _sentinel_lane(batch) != pad_sentinel(side)
+
+
+def make_padding_like(batch, n: int, side: str):
+    """A block of n invalid tuples with the same structure as ``batch``."""
+    sent = jnp.full((n,), pad_sentinel(side), dtype=jnp.uint32)
+    rid = jnp.full((n,), PAD_RID, dtype=jnp.uint32)
+    hi = sent if batch[2] is not None else None
+    return type(batch)(sent, rid, hi)
+
+
+def make_padding(n: int, side: str, wide: bool = False) -> CompressedBatch:
+    """A block of n invalid compressed tuples."""
+    sent = jnp.full((n,), pad_sentinel(side), dtype=jnp.uint32)
+    rid = jnp.full((n,), PAD_RID, dtype=jnp.uint32)
+    if wide:
+        return CompressedBatch(key_rem=sent, rid=rid, key_rem_hi=sent)
+    return CompressedBatch(key_rem=sent, rid=rid)

@@ -1,0 +1,11 @@
+from tpu_radix_join.histograms.local_histogram import compute_local_histogram
+from tpu_radix_join.histograms.global_histogram import compute_global_histogram
+from tpu_radix_join.histograms.assignment_map import compute_partition_assignment
+from tpu_radix_join.histograms.offset_map import compute_offsets
+
+__all__ = [
+    "compute_local_histogram",
+    "compute_global_histogram",
+    "compute_partition_assignment",
+    "compute_offsets",
+]

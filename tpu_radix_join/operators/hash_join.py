@@ -1,0 +1,244 @@
+"""HashJoin: the full distributed pipeline as one SPMD program.
+
+Replaces ``operators/HashJoin.{h,cpp}`` — the 4-phase orchestration with
+barriers, phase timers, and a task queue (HashJoin.cpp:45-220).  The TPU-native
+shape: every phase — local histogram, global histogram (psum), assignment,
+offsets (all_gather exscan), network partitioning (all_to_all), local
+partitioning, build-probe — is traced into **one shard_map program** compiled
+by XLA over the mesh; MPI barriers (HashJoin.cpp:50,120) become XLA program
+order, and the sequential ``TASK_QUEUE`` drain (HashJoin.cpp:187-204) becomes
+vectorized per-partition work in the same program.
+
+Match counts are returned per network partition in uint32 (each partition's
+count stays < 2**32) and summed on host in uint64 so billion-scale totals are
+exact without device int64 (SURVEY.md §7.4 item 2).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_radix_join.core.config import JoinConfig
+from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.tuples import (
+    CompressedBatch,
+    R_PAD_KEY,
+    TupleBatch,
+    _sentinel_lane,
+)
+from tpu_radix_join.histograms import (
+    compute_global_histogram,
+    compute_local_histogram,
+    compute_offsets,
+    compute_partition_assignment,
+)
+from tpu_radix_join.ops.build_probe import (
+    probe_count_bucketized,
+    probe_count_per_partition,
+)
+from tpu_radix_join.operators.local_partitioning import local_partition
+from tpu_radix_join.parallel.mesh import make_mesh
+from tpu_radix_join.parallel.network_partitioning import network_partition
+from tpu_radix_join.parallel.window import ExchangeResult, Window
+
+
+class JoinResult(NamedTuple):
+    matches: int             # exact global match count (host uint64 sum)
+    ok: bool                 # conservation invariants held (no overflow, counts conserved)
+    partition_counts: np.ndarray  # per-device per-partition (or per-bucket) uint32
+
+
+def _as_compressed(batch: TupleBatch) -> CompressedBatch:
+    """Identity-compression view: the sort probe compares full keys (safe
+    across mixed partitions in the receive buffer; see network_partitioning
+    docstring), so fanout-0 compression is used here."""
+    return CompressedBatch(key_rem=batch.key, rid=batch.rid, key_rem_hi=batch.key_hi)
+
+
+class HashJoin:
+    """Host-side driver: owns the mesh, compiles the pipeline, runs joins.
+
+    Equivalent of constructing ``hpcjoin::operators::HashJoin`` and calling
+    ``join()`` (main.cpp:110-121), except construction compiles an SPMD
+    program instead of wiring a task queue.
+    """
+
+    def __init__(self, config: JoinConfig, mesh: Optional[Mesh] = None):
+        self.config = config
+        self.mesh = mesh if mesh is not None else make_mesh(config.num_nodes,
+                                                            config.mesh_axis)
+        if self.mesh.devices.size != config.num_nodes:
+            raise ValueError(
+                f"mesh has {self.mesh.devices.size} devices, config expects "
+                f"{config.num_nodes}")
+        self._compiled = {}
+
+    # ------------------------------------------------------------------ build
+    def _histogram_fn(self):
+        """Phase 1+2 front half: per-(sender, destination) shuffle demand.
+
+        The reference sizes each RMA window exactly from the global histogram
+        in its window-allocation phase (Window.cpp:168-177, HashJoin.cpp:73-89)
+        — a runtime-sized allocation XLA cannot express inside one program.
+        The TPU equivalent is shape specialization: this small program computes
+        the true send demands; the host rounds the max up to a power of two and
+        compiles the shuffle program at that static capacity.  Guarantees the
+        conservation invariant regardless of skew (SURVEY.md §7.4 item 1).
+        """
+        cfg = self.config
+        ax = cfg.mesh_axis
+        n = cfg.num_nodes
+        fanout = cfg.network_fanout_bits
+
+        def body(r: TupleBatch, s: TupleBatch):
+            _, r_hist = compute_local_histogram(r, fanout)
+            _, s_hist = compute_local_histogram(s, fanout)
+            r_ghist = compute_global_histogram(r_hist, ax)
+            s_ghist = compute_global_histogram(s_hist, ax)
+            assignment = compute_partition_assignment(
+                r_ghist, s_ghist, n, cfg.assignment_policy)
+            dest_onehot = (
+                assignment[None, :] == jnp.arange(n, dtype=jnp.uint32)[:, None]
+            )  # [N_dest, P]
+            r_demand = jnp.sum(jnp.where(dest_onehot, r_hist[None, :], 0), axis=1)
+            s_demand = jnp.sum(jnp.where(dest_onehot, s_hist[None, :], 0), axis=1)
+            return r_demand.astype(jnp.uint32), s_demand.astype(jnp.uint32)
+
+        spec = P(cfg.mesh_axis)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)))
+
+    def _measure_capacities(self, r: TupleBatch, s: TupleBatch):
+        """Window allocation (HashJoin.cpp phase 2): static block capacity =
+        next power of two >= worst (sender, dest) demand, or the
+        allocation-factor estimate in "static" mode (no sizing pre-pass)."""
+        n = self.config.num_nodes
+        if self.config.window_sizing == "static":
+            return (self.config.shuffle_block_capacity(r.size // n),
+                    self.config.shuffle_block_capacity(s.size // n))
+        if "hist" not in self._compiled:
+            self._compiled["hist"] = self._histogram_fn()
+        r_demand, s_demand = self._compiled["hist"](r, s)
+
+        def cap(demand):
+            worst = max(1, int(np.asarray(demand).max()))
+            return max(8, 1 << (worst - 1).bit_length())
+
+        return cap(r_demand), cap(s_demand)
+
+    def _pipeline_fn(self, local_size_r: int, local_size_s: int,
+                     cap_r: int, cap_s: int):
+        cfg = self.config
+        ax = cfg.mesh_axis
+        n = cfg.num_nodes
+        fanout = cfg.network_fanout_bits
+        num_p = cfg.network_partition_count
+        win_r = Window(n, cap_r, ax, "inner")
+        win_s = Window(n, cap_s, ax, "outer")
+
+        def body(r: TupleBatch, s: TupleBatch):
+            # Input contract: real keys must stay below the padding sentinels
+            # (tuples.py).  Violations flip `ok` rather than silently
+            # overcounting against padding slots.
+            keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
+                jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
+
+            # ---- Phase 1: histogram computation (HashJoin.cpp:58-64) ----
+            r_pid, r_hist = compute_local_histogram(r, fanout)
+            s_pid, s_hist = compute_local_histogram(s, fanout)
+            r_ghist = compute_global_histogram(r_hist, ax)
+            s_ghist = compute_global_histogram(s_hist, ax)
+            assignment = compute_partition_assignment(
+                r_ghist, s_ghist, n, cfg.assignment_policy)
+            r_off = compute_offsets(r_hist, r_ghist, assignment, ax)
+            s_off = compute_offsets(s_hist, s_ghist, assignment, ax)
+
+            # ---- Phase 2: window allocation is implicit (static shapes) ----
+            # ---- Phase 3: network partitioning (HashJoin.cpp:98-105) ----
+            rp = network_partition(r, fanout, assignment, win_r)
+            sp = network_partition(s, fanout, assignment, win_s)
+
+            # ---- Phase 4: sync barrier -> implicit in program order ----
+            ok_r = win_r.assert_all_tuples_written(
+                ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
+                r_ghist, assignment)
+            ok_s = win_s.assert_all_tuples_written(
+                ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
+                s_ghist, assignment)
+
+            # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
+            if cfg.two_level or cfg.probe_algorithm == "bucket":
+                if r.key_hi is not None:
+                    raise NotImplementedError(
+                        "bucketized probe compares the 32-bit key lane only; "
+                        "use probe_algorithm='sort' for 64-bit keys")
+                nb = cfg.local_partition_count
+                lcap_r = cfg.bucket_capacity(n * cap_r, nb)
+                lcap_s = cfg.bucket_capacity(n * cap_s, nb)
+                lr = local_partition(rp.batch, rp.valid, fanout,
+                                     cfg.local_fanout_bits, lcap_r, "inner")
+                ls = local_partition(sp.batch, sp.valid, fanout,
+                                     cfg.local_fanout_bits, lcap_s, "outer")
+                counts = probe_count_bucketized(
+                    lr.blocks.key.reshape(nb, lcap_r),
+                    ls.blocks.key.reshape(nb, lcap_s))
+                ok_local = (lr.overflow + ls.overflow) == 0
+            else:
+                counts = probe_count_per_partition(
+                    _as_compressed(rp.batch), _as_compressed(sp.batch),
+                    sp.pid, num_p)
+                ok_local = jnp.bool_(True)
+
+            ok = ok_r & ok_s & ok_local & keys_ok
+            ok_global = jax.lax.psum((~ok).astype(jnp.uint32), ax) == 0
+            return counts, ok_global
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(spec, spec),
+            out_specs=(spec, P()),
+        ))
+
+    def _get_compiled(self, local_r: int, local_s: int, cap_r: int, cap_s: int):
+        key = (local_r, local_s, cap_r, cap_s)
+        if key not in self._compiled:
+            self._compiled[key] = self._pipeline_fn(local_r, local_s, cap_r, cap_s)
+        return self._compiled[key]
+
+    # ------------------------------------------------------------------- run
+    def join_arrays(self, r: TupleBatch, s: TupleBatch) -> JoinResult:
+        """Join globally-sharded TupleBatch arrays (leading dim divisible by
+        the mesh size)."""
+        n = self.config.num_nodes
+        if r.size % n or s.size % n:
+            raise ValueError("relation sizes must divide the mesh size")
+        cap_r, cap_s = self._measure_capacities(r, s)
+        fn = self._get_compiled(r.size // n, s.size // n, cap_r, cap_s)
+        counts, ok = fn(r, s)
+        counts = np.asarray(counts)
+        matches = int(counts.astype(np.uint64).sum())
+        return JoinResult(matches=matches, ok=bool(ok), partition_counts=counts)
+
+    def join(self, inner: Relation, outer: Relation) -> JoinResult:
+        """Join two relation specs (generates shards, shards onto the mesh)."""
+        n = self.config.num_nodes
+        if inner.num_nodes != n or outer.num_nodes != n:
+            raise ValueError("relation num_nodes must match config.num_nodes")
+        sharding = NamedSharding(self.mesh, P(self.config.mesh_axis))
+
+        def gather(rel: Relation) -> TupleBatch:
+            shards = [rel.shard_np(i) for i in range(n)]
+            keys = np.concatenate([k for k, _ in shards])
+            rids = np.concatenate([r for _, r in shards])
+            return TupleBatch(
+                key=jax.device_put(keys, sharding),
+                rid=jax.device_put(rids, sharding))
+
+        return self.join_arrays(gather(inner), gather(outer))

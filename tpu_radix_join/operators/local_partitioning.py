@@ -1,0 +1,60 @@
+"""Local (second-level) radix partitioning.
+
+Replaces ``tasks/LocalPartitioning.{h,cpp}``: the optional second radix pass
+that refines each node's received tuples by the next ``LOCAL_PARTITIONING_FANOUT``
+key bits so every build-probe bucket fits fast memory (histogram over bits
+``[f, f+l)`` — LocalPartitioning.cpp:147-155; prefix sum :165-192; SWWC
+reorder :194-250; one BuildProbe task per sub-partition :116-124).
+
+TPU design: the reorder is a static-shape block scatter
+(ops/radix.scatter_to_blocks) keyed on the local bucket id, yielding a
+[num_buckets, capacity] layout whose rows are the "BuildProbe tasks" — consumed
+in one shot by the dense bucketized probe (ops/build_probe.probe_count_bucketized),
+the analog of draining ``TASK_QUEUE`` (HashJoin.cpp:187-204) in parallel
+instead of a FIFO loop.  Bucket id uses only the local bits (network bits are
+dropped, as in the reference's compressed layout); the probe compares full
+keys, so tuples from different network partitions sharing local bits can never
+falsely match.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.radix import scatter_to_blocks, local_histogram, exclusive_cumsum
+
+
+class LocalPartitionResult(NamedTuple):
+    blocks: TupleBatch       # [num_buckets * capacity] lanes, sentinel-padded
+    histogram: jnp.ndarray   # uint32 [num_buckets] — true per-bucket demand
+    offsets: jnp.ndarray     # uint32 [num_buckets] — exclusive prefix sum
+    overflow: jnp.ndarray    # uint32 — tuples that did not fit their bucket
+
+
+def local_bucket_ids(batch: TupleBatch, network_fanout_bits: int,
+                     local_fanout_bits: int) -> jnp.ndarray:
+    """Bucket = key bits [f, f+l) (LocalPartitioning.cpp:147-155)."""
+    f = jnp.uint32(network_fanout_bits)
+    mask = jnp.uint32((1 << local_fanout_bits) - 1)
+    return (batch.key >> f) & mask
+
+
+def local_partition(
+    batch: TupleBatch,
+    valid: jnp.ndarray,
+    network_fanout_bits: int,
+    local_fanout_bits: int,
+    capacity: int,
+    side: str,
+) -> LocalPartitionResult:
+    num_buckets = 1 << local_fanout_bits
+    lpid = local_bucket_ids(batch, network_fanout_bits, local_fanout_bits)
+    blocks, counts, overflow = scatter_to_blocks(
+        batch, lpid, num_buckets, capacity, side, valid=valid)
+    hist = local_histogram(lpid, num_buckets, valid)
+    return LocalPartitionResult(
+        blocks=blocks, histogram=hist, offsets=exclusive_cumsum(hist),
+        overflow=overflow)

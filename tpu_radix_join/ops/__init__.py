@@ -1,0 +1,19 @@
+from tpu_radix_join.ops.radix import (
+    local_histogram,
+    reorder_by_partition,
+    scatter_to_blocks,
+)
+from tpu_radix_join.ops.build_probe import (
+    probe_count,
+    probe_count_bucketized,
+    probe_materialize,
+)
+
+__all__ = [
+    "local_histogram",
+    "reorder_by_partition",
+    "scatter_to_blocks",
+    "probe_count",
+    "probe_count_bucketized",
+    "probe_materialize",
+]

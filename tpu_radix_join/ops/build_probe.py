@@ -1,0 +1,139 @@
+"""Build-probe: match counting and materialization.
+
+Replaces the CPU chained-bucket hash join (``tasks/BuildProbe.cpp:47-121``) and
+the GPU probe kernel families (``operators/gpu/eth.cu:25-109``,
+``kernels.cu:199-246`` SD::probe, ``kernels.cu:314-463`` probe_match_rate /
+probe_count).  Pointer-chasing hash tables are hostile to TPUs (SURVEY.md
+§7.2); the idiomatic equivalents provided here:
+
+  * :func:`probe_count` — sort the inner side by key, then a dual
+    ``searchsorted`` (left/right bounds) gives each outer tuple its exact,
+    duplicate-aware match count.  ``method='sort'`` lowers to a concat+sort,
+    fully parallel on the MXU-adjacent sort units; this is the default
+    BuildProbe (`probe_count` analog, kernels.cu:423-463).
+  * :func:`probe_count_bucketized` — after a radix pass each bucket is small
+    and dense, so probe = per-bucket dense equality reduction, the analog of
+    the shared-memory ``SD::probe`` that stages an R partition in shared memory
+    and nested-loops S against it (kernels.cu:199-246).
+  * :func:`probe_materialize` — emits matching (r_rid, s_rid) pairs up to a
+    static per-outer-tuple cap with an overflow flag, the analog of
+    ``probe_match_rate``'s per-thread ``matches[MAX_MATCH_RATE]`` buffer +
+    retry flag ``pFlag`` (kernels.cu:314-411).
+
+Padding contract: invalid slots carry side-specific sentinel keys
+(R_PAD != S_PAD, tuples.py) so padding can never match padding or real tuples;
+counts therefore need no extra masking.
+
+Match counts are accumulated in uint32 per partition; partitions are summed on
+host in uint64 (SURVEY.md §7.4 item 2 — avoids both int32 overflow and slow
+TPU int64).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_radix_join.data.tuples import CompressedBatch
+
+
+def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
+    """Single-lane comparable key for sort/searchsorted.
+
+    64-bit remainders need a uint64 lane; JAX x64 must be enabled for that
+    path (the 1B CompressedTuple config).  32-bit keys stay uint32.
+    """
+    if comp.key_rem_hi is None:
+        return comp.key_rem
+    if not jax.config.jax_enable_x64:
+        raise NotImplementedError(
+            "64-bit probe keys require jax_enable_x64 (uint64 sort lane)")
+    return (comp.key_rem_hi.astype(jnp.uint64) << 32) | comp.key_rem.astype(jnp.uint64)
+
+
+def _probe_bounds(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """(sorted r, left bounds, right bounds) for each s key."""
+    r_sorted = jnp.sort(r_keys)
+    lo = jnp.searchsorted(r_sorted, s_keys, side="left", method="sort")
+    hi = jnp.searchsorted(r_sorted, s_keys, side="right", method="sort")
+    return r_sorted, lo, hi
+
+
+def probe_count(inner: CompressedBatch, outer: CompressedBatch) -> jnp.ndarray:
+    """Exact number of matching (r, s) pairs, as uint32.
+
+    Handles duplicate keys on both sides (count per outer tuple = size of its
+    equal-key run in the sorted inner side).  Padding sentinels fall out: no
+    real or padded outer key ever equals an inner sentinel and vice versa.
+    """
+    _, lo, hi = _probe_bounds(_sort_key(inner), _sort_key(outer))
+    return jnp.sum((hi - lo).astype(jnp.uint32))
+
+
+def probe_count_per_partition(
+    inner: CompressedBatch, outer: CompressedBatch,
+    outer_pid: jnp.ndarray, num_partitions: int,
+) -> jnp.ndarray:
+    """Per-partition match counts, uint32 [num_partitions].
+
+    Keeps each accumulator < 2**32 so host-side uint64 summation is exact even
+    at billions of total matches (see module docstring).
+    """
+    _, lo, hi = _probe_bounds(_sort_key(inner), _sort_key(outer))
+    per_s = (hi - lo).astype(jnp.uint32)
+    return jnp.bincount(
+        outer_pid.astype(jnp.int32), weights=per_s, length=num_partitions
+    ).astype(jnp.uint32)
+
+
+def probe_count_bucketized(
+    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray
+) -> jnp.ndarray:
+    """Dense per-bucket compare: inner_blocks [nb, bi], outer_blocks [nb, bo]
+    single-lane keys (sentinel-padded).  Returns per-bucket match counts,
+    uint32 [nb].
+
+    O(bi*bo) per bucket — the trade the GPU shared-memory probe makes
+    (kernels.cu:199-246); profitable when radix fanout keeps buckets tiny.
+    """
+    eq = inner_blocks[:, :, None] == outer_blocks[:, None, :]
+    return jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
+
+
+class MaterializedMatches(NamedTuple):
+    r_rid: jnp.ndarray      # uint32 [n_outer * cap]
+    s_rid: jnp.ndarray      # uint32 [n_outer * cap]
+    valid: jnp.ndarray      # bool   [n_outer * cap]
+    overflow: jnp.ndarray   # uint32 — tuples whose match count exceeded cap
+
+
+def probe_materialize(
+    inner: CompressedBatch, outer: CompressedBatch, cap: int
+) -> MaterializedMatches:
+    """Materialize matching rid pairs, up to ``cap`` matches per outer tuple.
+
+    The analog of ``probe_match_rate`` (kernels.cu:314-411): a static output
+    buffer of ``n_outer * cap`` pairs plus an overflow indicator standing in
+    for the kernel's retry flag ``pFlag``.
+    """
+    rk = _sort_key(inner)
+    order = jnp.argsort(rk)
+    r_sorted = rk[order]
+    r_rid_sorted = inner.rid[order]
+    sk = _sort_key(outer)
+    lo = jnp.searchsorted(r_sorted, sk, side="left", method="sort")
+    hi = jnp.searchsorted(r_sorted, sk, side="right", method="sort")
+    n_outer = sk.shape[0]
+    k = jnp.arange(cap, dtype=jnp.int32)[None, :]              # [1, cap]
+    idx = lo[:, None] + k                                      # [n_outer, cap]
+    valid = idx < hi[:, None]
+    idx = jnp.minimum(idx, r_sorted.shape[0] - 1)
+    r_rid = r_rid_sorted[idx]
+    s_rid = jnp.broadcast_to(outer.rid[:, None], (n_outer, cap))
+    overflow = jnp.sum(((hi - lo) > cap).astype(jnp.uint32))
+    return MaterializedMatches(
+        r_rid=r_rid.reshape(-1), s_rid=s_rid.reshape(-1),
+        valid=valid.reshape(-1), overflow=overflow,
+    )

@@ -1,0 +1,113 @@
+"""Radix partitioning primitives, TPU-style.
+
+The reference's hot partitioning loops are per-tuple scattered writes made
+cache-friendly with software write-combining buffers and AVX non-temporal
+streams (``NetworkPartitioning.cpp:116-173,224-260``;
+``LocalPartitioning.cpp:194-250``).  SWWC has no TPU analog — the idiomatic
+equivalent (SURVEY.md §7.2) is *sort by partition id + offsets from a cumsum of
+the histogram*: one vectorized, statically-shaped reorder instead of per-tuple
+scatter.  These primitives are the shared core under both NetworkPartitioning
+(partition-to-destination-node routing) and LocalPartitioning (second radix
+pass), i.e. the TPU equivalents of the GPU ``histogram_build_L1/L2`` +
+``reorder_L1/L2`` kernel families (operators/gpu/kernels.cu:19-185).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_radix_join.data.tuples import CompressedBatch, make_padding_like
+
+
+def local_histogram(pid: jnp.ndarray, num_partitions: int,
+                    valid: jnp.ndarray | None = None) -> jnp.ndarray:
+    """Count tuples per partition (LocalHistogram.cpp:44-47).
+
+    ``pid`` uint32 [n]; returns uint32 [num_partitions].  ``valid`` masks out
+    padding slots (the reference never needs this because MPI buffers are
+    exactly sized; statically-shaped TPU blocks do).
+    """
+    weights = None if valid is None else valid.astype(jnp.uint32)
+    hist = jnp.bincount(pid.astype(jnp.int32), weights=weights, length=num_partitions)
+    return hist.astype(jnp.uint32)
+
+
+def exclusive_cumsum(hist: jnp.ndarray) -> jnp.ndarray:
+    """Partition base offsets = exclusive prefix sum of the histogram
+    (LocalPartitioning.cpp:165-192, minus the cacheline padding which has no
+    meaning for a dense reorder)."""
+    return jnp.concatenate([jnp.zeros((1,), hist.dtype), jnp.cumsum(hist)[:-1]])
+
+
+def reorder_by_partition(
+    batch: CompressedBatch, pid: jnp.ndarray, num_partitions: int,
+    valid: jnp.ndarray | None = None,
+) -> Tuple[CompressedBatch, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Stable reorder so each partition's tuples are contiguous.
+
+    Returns (reordered batch, reordered pid, histogram, base offsets).  Invalid
+    (padding) slots are routed to a virtual partition after all real ones so
+    they land at the tail.  The reorder itself is ``argsort`` on the partition
+    id — XLA lowers this to a parallel sort, the TPU replacement for the SWWC
+    scatter loop (see module docstring).
+    """
+    sort_key = pid.astype(jnp.uint32)
+    if valid is not None:
+        sort_key = jnp.where(valid, sort_key, jnp.uint32(num_partitions))
+    order = jnp.argsort(sort_key, stable=True)
+    out = jax.tree.map(lambda x: x[order], batch)
+    hist = local_histogram(pid, num_partitions, valid)
+    return out, pid[order], hist, exclusive_cumsum(hist)
+
+
+def scatter_to_blocks(
+    batch,
+    dest: jnp.ndarray,
+    num_blocks: int,
+    capacity: int,
+    side: str,
+    valid: jnp.ndarray | None = None,
+):
+    """Route tuples into ``num_blocks`` statically-sized blocks of ``capacity``
+    slots, padding unused slots with the side's sentinel.
+
+    This is the send half of the Window data plane: where the reference
+    ``MPI_Put``s exactly-sized slices computed by OffsetMap
+    (``Window.cpp:86-144``), XLA needs static shapes, so each destination gets
+    a fixed-capacity block and a valid count (SURVEY.md §7.2).
+
+    Returns (blocks batch with arrays shaped [num_blocks * capacity],
+    counts uint32 [num_blocks] — the *unclipped* per-destination demand, and
+    overflow uint32 — how many tuples did not fit; 0 in correct runs, checked
+    by Window.assert_all_tuples_written).
+    """
+    n = dest.shape[0]
+    sort_key = dest.astype(jnp.uint32)
+    if valid is not None:
+        sort_key = jnp.where(valid, sort_key, jnp.uint32(num_blocks))
+    order = jnp.argsort(sort_key, stable=True)
+    sorted_dest = sort_key[order]
+
+    counts = jnp.bincount(sort_key.astype(jnp.int32), length=num_blocks + 1)[
+        :num_blocks
+    ].astype(jnp.uint32)
+    starts = exclusive_cumsum(counts)
+    # Rank of each tuple within its destination run of the sorted order.
+    safe_dest = jnp.minimum(sorted_dest, jnp.uint32(num_blocks - 1))
+    rank = jnp.arange(n, dtype=jnp.uint32) - starts[safe_dest]
+    in_cap = rank < jnp.uint32(capacity)
+    is_real = sorted_dest < jnp.uint32(num_blocks)
+    ok = in_cap & is_real
+    slot = jnp.where(ok, safe_dest * jnp.uint32(capacity) + rank,
+                     jnp.uint32(num_blocks * capacity))  # OOB slot -> dropped
+
+    pad = make_padding_like(batch, num_blocks * capacity, side)
+    sorted_batch = jax.tree.map(lambda x: x[order], batch)
+    blocks = jax.tree.map(
+        lambda p, v: p.at[slot].set(v, mode="drop"), pad, sorted_batch
+    )
+    overflow = jnp.sum(jnp.where(is_real & ~in_cap, 1, 0)).astype(jnp.uint32)
+    return blocks, counts, overflow

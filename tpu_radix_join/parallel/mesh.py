@@ -1,0 +1,30 @@
+"""Device mesh helpers.
+
+Replaces the reference's SPMD bootstrap (``MPI_Init`` / ``Comm_size/rank``,
+main.cpp:36-48): on TPU the "cluster" is a ``jax.sharding.Mesh`` over the
+devices visible to the process (multi-host JAX extends this transparently —
+``jax.devices()`` spans hosts, the direct analog of a multi-node MPI world).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def make_mesh(num_nodes: int | None = None, axis_name: str = "nodes") -> Mesh:
+    """A 1-D mesh over the first ``num_nodes`` devices (default: all).
+
+    The join's parallelism is partitioned data parallelism over one axis
+    (SURVEY.md §2.3 item 1); higher-dimensional meshes are not needed.
+    """
+    devs = jax.devices()
+    n = num_nodes or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} nodes but only {len(devs)} devices")
+    return Mesh(np.asarray(devs[:n]), (axis_name,))

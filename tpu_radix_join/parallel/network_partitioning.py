@@ -1,0 +1,56 @@
+"""Network partitioning: route every tuple to its partition's owner node.
+
+Replaces ``tasks/NetworkPartitioning.{h,cpp}`` — the all-to-all shuffle
+producer.  The reference's per-tuple hot loop (hash, compress, SWWC cacheline
+append, AVX stream, 64KB ``MPI_Put`` with double buffering,
+NetworkPartitioning.cpp:116-173) becomes three vectorized steps:
+
+  1. partition id per tuple (radix bits, LocalHistogram.cpp:20);
+  2. destination node per tuple via the AssignmentMap
+     (``window->write``'s target resolution, Window.cpp:110);
+  3. one dense block scatter + ``all_to_all`` (parallel/window.py).
+
+Wire format parity: the reference ships 8B CompressedTuples; with 32-bit keys
+our two uint32 lanes (full key + rid) are the same 8B/tuple, and keeping the
+full key lets the receiver recompute partition ids instead of shipping them
+(compression to key remainders happens at the probe boundary instead —
+tuples.compress).  Communication/computation overlap (SURVEY.md §2.3 item 6)
+is XLA's job: the scatter and the collective are in one program and XLA/ICI
+pipeline them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from tpu_radix_join.data.tuples import TupleBatch, partition_ids, valid_mask
+from tpu_radix_join.parallel.window import Window, ExchangeResult
+
+
+class NetworkPartitionResult(NamedTuple):
+    batch: TupleBatch        # received tuples, [N * C] lanes, sentinel-padded
+    valid: jnp.ndarray       # bool [N * C]
+    pid: jnp.ndarray         # uint32 [N * C] — recomputed partition ids
+    recv_counts: jnp.ndarray # uint32 [N]
+    send_overflow: jnp.ndarray
+
+
+def network_partition(
+    batch: TupleBatch,
+    fanout_bits: int,
+    assignment: jnp.ndarray,
+    window: Window,
+    valid: jnp.ndarray | None = None,
+) -> NetworkPartitionResult:
+    """Runs inside shard_map over the mesh axis."""
+    pid = partition_ids(batch, fanout_bits)
+    dest = assignment[pid]
+    res: ExchangeResult = window.exchange(batch, dest, valid=valid)
+    recv_valid = valid_mask(res.batch, window.side)
+    recv_pid = partition_ids(res.batch, fanout_bits)
+    return NetworkPartitionResult(
+        batch=res.batch, valid=recv_valid, pid=recv_pid,
+        recv_counts=res.recv_counts, send_overflow=res.send_overflow,
+    )

@@ -1,0 +1,3 @@
+from tpu_radix_join.performance.measurements import Measurements
+
+__all__ = ["Measurements"]

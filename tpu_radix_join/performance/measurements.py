@@ -1,0 +1,102 @@
+"""Phase timers, counters, and .perf-compatible reporting.
+
+Replaces ``performance/Measurements.{h,cpp}`` (SURVEY.md §5.1): the
+reference's ~60 static start/stop functions around `gettimeofday` + PAPI
+cycles, compile-gated sub-timers, and per-rank ``<rank>.perf`` tag files
+gathered to rank 0.
+
+TPU design: a timer registry keyed by the reference's own tag vocabulary
+(JTOTAL, JHIST, JMPI, JPROC, SWINALLOC, ...) so baseline comparison is
+mechanical; fences are ``jax.block_until_ready`` (device work is async);
+hardware-counter analogs come from ``jax.profiler`` traces rather than PAPI.
+Everything under one jit cannot be phase-timed from the host, so phase timing
+is honest at the granularity the driver actually executes (histogram program /
+join program), with the jit-internal split available via profiler traces.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from collections import defaultdict
+from typing import Dict, Optional
+
+import jax
+
+# Reference tag vocabulary (Measurements.cpp:136-142,176-178,351-368,533-542)
+JTOTAL = "JTOTAL"          # end-to-end join wall time
+JHIST = "JHIST"            # histogram phase
+JMPI = "JMPI"              # network partitioning phase
+JPROC = "JPROC"            # local processing phase
+SWINALLOC = "SWINALLOC"    # window allocation (capacity measurement + compile)
+SNETCOMPL = "SNETCOMPL"    # network completion wait
+SLOCPREP = "SLOCPREP"      # local preparation
+
+
+class Measurements:
+    """Per-process measurement registry.
+
+    ``init`` -> ``Measurements::init`` (Measurements.cpp:707-749) minus the
+    MPI_Bcast of the experiment id (single-process drivers name their own).
+    """
+
+    def __init__(self, node_id: int = 0, num_nodes: int = 1,
+                 tag: str = "experiment"):
+        self.node_id = node_id
+        self.num_nodes = num_nodes
+        self.tag = tag
+        self._starts: Dict[str, float] = {}
+        self.times_us: Dict[str, float] = defaultdict(float)
+        self.counters: Dict[str, int] = defaultdict(int)
+        self.meta: Dict[str, object] = {
+            "host": socket.gethostname(),
+            "node": node_id,
+            "nodes": num_nodes,
+        }
+
+    # ----------------------------------------------------------------- timers
+    def start(self, key: str) -> None:
+        self._starts[key] = time.perf_counter()
+
+    def stop(self, key: str, fence=None) -> float:
+        """Stop a timer; ``fence`` (any pytree of jax arrays) is
+        block_until_ready'd first so async device work is included — the
+        equivalent of the reference's MPI barrier + gettimeofday pairing
+        (Measurements.cpp:90-134)."""
+        if fence is not None:
+            jax.block_until_ready(fence)
+        dt = (time.perf_counter() - self._starts.pop(key)) * 1e6
+        self.times_us[key] += dt
+        return dt
+
+    def add_time_us(self, key: str, us: float) -> None:
+        self.times_us[key] += us
+
+    def incr(self, key: str, by: int = 1) -> None:
+        self.counters[key] += by
+
+    # ---------------------------------------------------------------- output
+    def lines(self):
+        """Tagged key/value/unit lines in the reference's .perf format
+        (Measurements.cpp:136-142)."""
+        for k in sorted(self.times_us):
+            yield f"{k}\t{self.times_us[k]:.0f}\tus"
+        for k in sorted(self.counters):
+            yield f"{k}\t{self.counters[k]}\tcount"
+
+    def store(self, out_dir: str) -> str:
+        """Write ``<rank>.perf`` and ``<rank>.info`` (Measurements.cpp:707-770)."""
+        os.makedirs(out_dir, exist_ok=True)
+        perf = os.path.join(out_dir, f"{self.node_id}.perf")
+        with open(perf, "w") as f:
+            for line in self.lines():
+                f.write(line + "\n")
+        with open(os.path.join(out_dir, f"{self.node_id}.info"), "w") as f:
+            json.dump(self.meta, f, indent=2)
+        return perf
+
+    def summary(self) -> Dict[str, float]:
+        return {**{k: v for k, v in self.times_us.items()},
+                **{k: float(v) for k, v in self.counters.items()}}

@@ -1,0 +1,3 @@
+from tpu_radix_join.utils.debug import join_assert, join_debug
+
+__all__ = ["join_assert", "join_debug"]

@@ -1,0 +1,29 @@
+"""Debug/assert utilities.
+
+Replaces ``utils/Debug.h`` — the JOIN_DEBUG / JOIN_ASSERT printf+exit macros,
+compile-time gated by ``JOIN_DEBUG_PRINT`` (Debug.h:16-46).  The runtime gate
+here is the ``TPU_RADIX_JOIN_DEBUG`` env var (set to 1 to enable), fixing by
+construction the reference's dead flag-name mismatch (``JOIN_MEM_PRINT`` vs
+``JOIN_MEMORY_PRINT``, SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+DEBUG = os.environ.get("TPU_RADIX_JOIN_DEBUG", "0") not in ("0", "", "false")
+
+
+def join_debug(section: str, msg: str) -> None:
+    """JOIN_DEBUG analog (Debug.h:16-25)."""
+    if DEBUG:
+        print(f"[{section}] {msg}", file=sys.stderr)
+
+
+def join_assert(condition: bool, section: str, msg: str) -> None:
+    """JOIN_ASSERT analog (Debug.h:27-44): raises instead of exit(-1) so test
+    harnesses can catch it; host-side checks only (device-side invariants are
+    returned as bool outputs, see Window.assert_all_tuples_written)."""
+    if not condition:
+        raise AssertionError(f"[{section}] {msg}")
