@@ -25,28 +25,25 @@ def main():
     import jax.numpy as jnp
     from tpu_radix_join.data.relation import Relation
     from tpu_radix_join.data.tuples import TupleBatch
-    from tpu_radix_join.ops.local_join import local_join_partitioned
+    from tpu_radix_join.ops.local_join import local_join_merge
 
     size = 1 << 24               # 16M tuples per side
-    fanout_bits = 7              # 128 partitions
-    capacity = (size >> fanout_bits) * 2
 
     r_rel = Relation(size, 1, "unique", seed=1)
     s_rel = Relation(size, 1, "unique", seed=2)
     r = jax.block_until_ready(r_rel.shard(0))
     s = jax.block_until_ready(s_rel.shard(0))
 
-    counts, overflow = local_join_partitioned(r, s, fanout_bits, capacity)
+    counts = local_join_merge(r, s)
     matches = int(np.asarray(counts).astype(np.uint64).sum())
-    assert int(overflow) == 0, "partition capacity overflow"
     assert matches == size, (matches, size)
 
     # steady-state timing (compile already cached by the correctness run)
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
-        counts, overflow = local_join_partitioned(r, s, fanout_bits, capacity)
-    jax.block_until_ready((counts, overflow))
+        counts = local_join_merge(r, s)
+    jax.block_until_ready(counts)
     dt = (time.perf_counter() - t0) / iters
 
     tuples_per_sec = (2 * size) / dt   # both relations processed

@@ -1,0 +1,65 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.relation import host_join_count
+from tpu_radix_join.data.tuples import R_PAD_KEY, S_PAD_KEY
+from tpu_radix_join.ops.merge_count import (
+    MAX_MERGE_KEY,
+    merge_count_chunks,
+    merge_count_per_partition,
+)
+
+
+def _total(counts):
+    return int(np.asarray(counts).astype(np.uint64).sum())
+
+
+def test_merge_count_duplicates():
+    rng = np.random.default_rng(0)
+    r = rng.integers(0, 300, 5000).astype(np.uint32)
+    s = rng.integers(0, 300, 4000).astype(np.uint32)
+    got = _total(merge_count_chunks(jnp.asarray(r), jnp.asarray(s)))
+    assert got == host_join_count(r, s)
+
+
+def test_merge_count_no_matches():
+    r = np.arange(0, 100, dtype=np.uint32)
+    s = np.arange(1000, 1100, dtype=np.uint32)
+    assert _total(merge_count_chunks(jnp.asarray(r), jnp.asarray(s))) == 0
+
+
+def test_merge_count_ignores_padding():
+    r = np.concatenate([np.array([1, 2, 3], np.uint32),
+                        np.full(10, R_PAD_KEY, np.uint32)])
+    s = np.concatenate([np.array([2, 2], np.uint32),
+                        np.full(20, S_PAD_KEY, np.uint32)])
+    assert _total(merge_count_chunks(jnp.asarray(r), jnp.asarray(s))) == 2
+
+
+def test_merge_count_out_of_range_keys_dont_match():
+    # keys above MAX_MERGE_KEY are routed to pad slots (the pipeline-level
+    # keys_ok check reports them); they must never produce matches
+    big = np.uint32(MAX_MERGE_KEY + 1)
+    r = np.array([big, 5], np.uint32)
+    s = np.array([big, 5], np.uint32)
+    assert _total(merge_count_chunks(jnp.asarray(r), jnp.asarray(s))) == 1
+
+
+def test_merge_count_per_partition_matches_oracle():
+    rng = np.random.default_rng(1)
+    r = rng.integers(0, 512, 3000).astype(np.uint32)
+    s = rng.integers(0, 512, 2500).astype(np.uint32)
+    per = np.asarray(merge_count_per_partition(jnp.asarray(r), jnp.asarray(s), 4))
+    assert per.shape == (16,)
+    assert per.sum() == host_join_count(r, s)
+    for p in (0, 7, 15):
+        expect = host_join_count(r[(r % 16) == p], s[(s % 16) == p])
+        assert per[p] == expect
+
+
+def test_merge_count_asymmetric_sizes():
+    rng = np.random.default_rng(2)
+    r = rng.integers(0, 100, 10).astype(np.uint32)
+    s = rng.integers(0, 100, 9999).astype(np.uint32)
+    got = _total(merge_count_chunks(jnp.asarray(r), jnp.asarray(s)))
+    assert got == host_join_count(r, s)

@@ -126,8 +126,12 @@ class Relation:
             raise ValueError("modulo kind requires modulo=")
         if kind == "zipf" and zipf_theta is None:
             raise ValueError("zipf kind requires zipf_theta=")
-        if key_bits == 32 and global_size > (1 << 31):
-            raise ValueError("32-bit keys cap global_size at 2**31 (sentinel headroom)")
+        # Deliberate contract: benchmark relations stay within the merge-probe
+        # key range so every probe discipline accepts them interchangeably.
+        if key_bits == 32 and global_size > (1 << 31) - 2:
+            raise ValueError(
+                "32-bit keys cap global_size at 2**31 - 2 (31-bit merge-count "
+                "packing + sentinel headroom); use key_bits=64 beyond that")
         self.global_size = int(global_size)
         self.num_nodes = int(num_nodes)
         self.kind = kind

@@ -42,6 +42,7 @@ from tpu_radix_join.ops.build_probe import (
     probe_count_bucketized,
     probe_count_per_partition,
 )
+from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY, merge_count_per_partition
 from tpu_radix_join.operators.local_partitioning import local_partition
 from tpu_radix_join.parallel.mesh import make_mesh
 from tpu_radix_join.parallel.network_partitioning import network_partition
@@ -144,10 +145,14 @@ class HashJoin:
 
         def body(r: TupleBatch, s: TupleBatch):
             # Input contract: real keys must stay below the padding sentinels
-            # (tuples.py).  Violations flip `ok` rather than silently
-            # overcounting against padding slots.
-            keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
-                jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
+            # (tuples.py) — and below the 31-bit merge-count packing limit
+            # when the merge probe is the branch in use.  Violations flip `ok`
+            # rather than silently overcounting against padding slots.
+            uses_merge = (r.key_hi is None and not cfg.two_level
+                          and cfg.probe_algorithm != "bucket")
+            key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
+            keys_ok = (jnp.max(_sentinel_lane(r)) < key_cap) & (
+                jnp.max(_sentinel_lane(s)) < key_cap)
 
             # ---- Phase 1: histogram computation (HashJoin.cpp:58-64) ----
             r_pid, r_hist = compute_local_histogram(r, fanout)
@@ -189,10 +194,15 @@ class HashJoin:
                     lr.blocks.key.reshape(nb, lcap_r),
                     ls.blocks.key.reshape(nb, lcap_s))
                 ok_local = (lr.overflow + ls.overflow) == 0
-            else:
+            elif r.key_hi is not None:
+                # 64-bit keys: searchsorted discipline (uint64 lane, needs x64)
                 counts = probe_count_per_partition(
                     _as_compressed(rp.batch), _as_compressed(sp.batch),
                     sp.pid, num_p)
+                ok_local = jnp.bool_(True)
+            else:
+                counts = merge_count_per_partition(
+                    rp.batch.key, sp.batch.key, fanout)
                 ok_local = jnp.bool_(True)
 
             ok = ok_r & ok_s & ok_local & keys_ok

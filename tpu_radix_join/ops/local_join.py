@@ -37,6 +37,24 @@ def local_join_sorted(r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
     return jnp.sum((hi - lo).astype(jnp.uint32))
 
 
+def local_join_merge(r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
+    """Chunked match counts (uint32 [4096], host-sum in uint64) via the
+    sort-merge counting discipline (ops/merge_count.py) — the fastest
+    single-chip probe measured on v5e (one 2n sort + scans; no searchsorted,
+    no gathers).  32-bit keys only (compares the low lane)."""
+    if r.key_hi is not None or s.key_hi is not None:
+        raise NotImplementedError(
+            "local_join_merge compares the 32-bit key lane only; use "
+            "probe_count (x64) for 64-bit keys")
+    return _local_join_merge(r.key, s.key)
+
+
+@jax.jit
+def _local_join_merge(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
+    from tpu_radix_join.ops.merge_count import merge_count_chunks
+    return merge_count_chunks(r_keys, s_keys)
+
+
 @functools.partial(jax.jit, static_argnames=("fanout_bits", "capacity"))
 def local_join_partitioned(
     r: TupleBatch, s: TupleBatch, fanout_bits: int, capacity: int
