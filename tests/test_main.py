@@ -1,0 +1,31 @@
+"""Driver CLI tests (main.cpp analog)."""
+
+import numpy as np
+
+from tpu_radix_join.main import main
+
+
+def test_cli_single_node(capsys, tmp_path):
+    rc = main(["--tuples-per-node", "4096", "--nodes", "1",
+               "--network-fanout", "4", "--output-dir", str(tmp_path / "exp")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[RESULTS] Tuples: 4096" in out
+    assert "(OK)" in out
+    assert "Conservation: OK" in out
+    assert (tmp_path / "exp" / "0.perf").exists()
+
+
+def test_cli_multi_node_zipf(capsys):
+    rc = main(["--tuples-per-node", "2048", "--nodes", "8",
+               "--outer-kind", "zipf", "--assignment", "load_aware"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "[RESULTS] Tuples: 16384" in out
+
+
+def test_cli_measurement_tags(capsys):
+    main(["--tuples-per-node", "1024", "--nodes", "2"])
+    out = capsys.readouterr().out
+    for tag in ("JTOTAL", "JPROC", "SWINALLOC", "RESULTS", "RTUPLES"):
+        assert tag in out

@@ -26,7 +26,9 @@ the global permutation with no host materialization (SURVEY.md §7.4 item 5).
 
 from __future__ import annotations
 
+import ctypes
 import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -34,8 +36,50 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.native.build import load as _load_native
 
 _FEISTEL_ROUNDS = 6
+_ZIPF_TABLE_MAX = 65536
+
+
+def _splitmix64(x: np.ndarray) -> np.ndarray:
+    """Vectorized splitmix64 — must match datagen.cc exactly."""
+    x = (x + np.uint64(0x9E3779B97F4A7C15))
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))
+
+
+def zipf_cdf_table(theta: float, domain: int) -> np.ndarray:
+    """Unnormalized Zipf(1+theta) rank CDF, float64 [min(domain, 65536)].
+
+    Built once in Python and shared verbatim with the native sampler so both
+    paths draw bit-identical keys."""
+    table = min(domain, _ZIPF_TABLE_MAX)
+    ranks = np.arange(1, table + 1, dtype=np.float64)
+    return np.cumsum(1.0 / np.power(ranks, 1.0 + theta))
+
+
+def zipf_keys_np(start: int, count: int, cdf: np.ndarray, domain: int,
+                 theta: float, seed: int) -> np.ndarray:
+    """numpy twin of datagen.cc fill_zipf (same table, same index hashing,
+    same continuous power-law tail for ranks past the table)."""
+    table = len(cdf)
+    head = cdf[-1]
+    t_pow = float(table) ** -theta
+    d_pow = float(domain) ** -theta
+    tail = (t_pow - d_pow) / theta if domain > table else 0.0
+    idx = np.uint64(seed) ^ np.arange(start, start + count, dtype=np.uint64)
+    with np.errstate(over="ignore"):
+        u = (_splitmix64(idx) >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
+    target = u * (head + tail)
+    key = np.searchsorted(cdf, np.minimum(target, head), side="left").astype(np.uint64)
+    in_tail = target > head
+    if tail > 0.0 and in_tail.any():
+        frac = (target[in_tail] - head) / tail
+        x = np.power(t_pow - frac * (t_pow - d_pow), -1.0 / theta)
+        key[in_tail] = np.clip(x.astype(np.uint64), table, domain - 1)
+    return key.astype(np.uint32)
 
 
 def _feistel_round_np(l, r, k, half_bits):
@@ -124,8 +168,8 @@ class Relation:
             raise ValueError(f"unknown relation kind {kind!r}")
         if kind == "modulo" and not modulo:
             raise ValueError("modulo kind requires modulo=")
-        if kind == "zipf" and zipf_theta is None:
-            raise ValueError("zipf kind requires zipf_theta=")
+        if kind == "zipf" and (zipf_theta is None or zipf_theta <= 0):
+            raise ValueError("zipf kind requires zipf_theta= > 0")
         # Deliberate contract: benchmark relations stay within the merge-probe
         # key range so every probe discipline accepts them interchangeably.
         if key_bits == 32 and global_size > (1 << 31) - 2:
@@ -146,26 +190,54 @@ class Relation:
         return self.global_size // self.num_nodes
 
     # ------------------------------------------------------------------ host
-    def shard_np(self, node: int) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys, rids) as numpy uint32 arrays for one node's shard."""
+    def shard_np(self, node: int, num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, rids) as numpy uint32 arrays for one node's shard.
+
+        Uses the native multithreaded generators (native/datagen.cc) when the
+        toolchain produced the shared library; the numpy fallbacks are
+        bit-identical (same Feistel rounds / same Zipf table + hashing)."""
         lo = node * self.local_size
         hi = lo + self.local_size
+        n = self.local_size
+        lib = _load_native()
+        if num_threads <= 0:
+            num_threads = min(16, os.cpu_count() or 1)
         rid = np.arange(lo, hi, dtype=np.uint32)
+        key = np.empty(n, dtype=np.uint32)
+        kp = key.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+
         if self.kind == "unique":
-            idx = np.arange(lo, hi, dtype=np.uint64)
             domain_bits = max(2, (self.global_size - 1).bit_length())
+            if lib is not None:
+                rk = np.ascontiguousarray(_feistel_keys(self.seed))
+                lib.fill_unique(
+                    kp, lo, n, self.global_size, (domain_bits + 1) // 2,
+                    rk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                    num_threads)
+                return key, rid
+            idx = np.arange(lo, hi, dtype=np.uint64)
             key = feistel_permutation_np(idx, domain_bits, self.seed)
             while (key >= self.global_size).any():
                 out = key >= self.global_size
                 key[out] = feistel_permutation_np(key[out], domain_bits, self.seed)
             return key.astype(np.uint32), rid
+
         if self.kind == "modulo":
+            if lib is not None:
+                lib.fill_modulo(kp, lo, n, self.modulo, num_threads)
+                return key, rid
             return (rid % np.uint32(self.modulo)).astype(np.uint32), rid
+
         # zipf: skewed draw over [0, key_domain)
-        rng = np.random.default_rng(self.seed + node)
-        ranks = rng.zipf(max(1.0001, 1.0 + self.zipf_theta), size=self.local_size)
-        key = ((ranks - 1) % self.key_domain).astype(np.uint32)
-        return key, rid
+        cdf = zipf_cdf_table(self.zipf_theta, self.key_domain)
+        if lib is not None:
+            lib.fill_zipf(
+                kp, lo, n, cdf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                len(cdf), self.key_domain, ctypes.c_double(self.zipf_theta),
+                self.seed, num_threads)
+            return key, rid
+        return zipf_keys_np(lo, n, cdf, self.key_domain, self.zipf_theta,
+                            self.seed), rid
 
     # ---------------------------------------------------------------- device
     def shard(self, node: int) -> TupleBatch:

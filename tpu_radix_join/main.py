@@ -1,0 +1,110 @@
+"""Driver CLI: the ``main.cpp`` analog, with runtime flags.
+
+The reference hard-codes its workload (20M tuples/node, seed 1234+rank,
+main.cpp:70-71,94) and parses no arguments (main.cpp:28); every knob is a
+compile-time constant.  Here the same driver flow — init measurements, size
+the pool, generate relations, run the join, aggregate + store results
+(main.cpp:28-149) — is a proper CLI over the typed JoinConfig.
+
+Usage:
+    python -m tpu_radix_join.main --tuples-per-node 1048576 --nodes 1
+    python -m tpu_radix_join.main --nodes 8 --outer-kind zipf --zipf-theta 0.75 \
+        --assignment load_aware
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu_radix_join",
+        description="Distributed radix hash join on a TPU mesh")
+    p.add_argument("--tuples-per-node", type=int, default=1 << 20,
+                   help="tuples per node per relation (reference: 20M, main.cpp:70)")
+    p.add_argument("--nodes", type=int, default=0,
+                   help="mesh size (0 = all visible devices)")
+    p.add_argument("--network-fanout", type=int, default=5,
+                   help="network radix bits (Configuration.h:30)")
+    p.add_argument("--local-fanout", type=int, default=5)
+    p.add_argument("--two-level", action="store_true",
+                   help="enable second-level partitioning (Configuration.h:28)")
+    p.add_argument("--probe", choices=["sort", "bucket"], default="sort")
+    p.add_argument("--assignment", choices=["round_robin", "load_aware"],
+                   default="round_robin")
+    p.add_argument("--window-sizing", choices=["measured", "static"],
+                   default="measured")
+    p.add_argument("--outer-kind", choices=["unique", "modulo", "zipf"],
+                   default="unique")
+    p.add_argument("--modulo", type=int, default=None)
+    p.add_argument("--zipf-theta", type=float, default=0.75)
+    p.add_argument("--seed", type=int, default=1234,
+                   help="base seed (reference: srand(1234+nodeId), main.cpp:94)")
+    p.add_argument("--output-dir", default=None,
+                   help="experiment dir for .perf/.info files (default: none)")
+    p.add_argument("--repeat", type=int, default=1)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    import jax
+    from tpu_radix_join import HashJoin, JoinConfig, Relation
+    from tpu_radix_join.performance import Measurements
+
+    nodes = args.nodes or jax.device_count()
+    cfg = JoinConfig(
+        num_nodes=nodes,
+        network_fanout_bits=args.network_fanout,
+        local_fanout_bits=args.local_fanout,
+        two_level=args.two_level,
+        probe_algorithm=args.probe,
+        assignment_policy=args.assignment,
+        window_sizing=args.window_sizing,
+    )
+    global_size = args.tuples_per_node * nodes
+    inner = Relation(global_size, nodes, "unique", seed=args.seed)
+    outer_kw = {}
+    if args.outer_kind == "modulo":
+        outer_kw["modulo"] = args.modulo or max(1, global_size // 4)
+    elif args.outer_kind == "zipf":
+        outer_kw["zipf_theta"] = args.zipf_theta
+        outer_kw["key_domain"] = global_size
+    outer = Relation(global_size, nodes, args.outer_kind,
+                     seed=args.seed + 1, **outer_kw)
+
+    meas = Measurements(node_id=0, num_nodes=nodes)
+    meas.meta.update(tuples_per_node=args.tuples_per_node,
+                     global_size=global_size, config=vars(args))
+    engine = HashJoin(cfg, measurements=meas)
+
+    expected = inner.expected_matches(outer)
+    result = None
+    for i in range(args.repeat):
+        result = engine.join(inner, outer)
+
+    # The reference's rank-0 aggregate report (Measurements.cpp:592-702)
+    print(f"[RESULTS] Tuples: {result.matches}")
+    if expected is not None:
+        status = "OK" if result.matches == expected else "MISMATCH"
+        print(f"[RESULTS] Expected: {expected} ({status})")
+    print(f"[RESULTS] Conservation: {'OK' if result.ok else 'VIOLATED'}")
+    total_us = meas.times_us.get("JTOTAL", 0.0)
+    if total_us:
+        rate = (2 * global_size * args.repeat) / (total_us / 1e6)
+        print(f"[RESULTS] Throughput: {rate / 1e6:.1f} M tuples/sec")
+    for line in meas.lines():
+        print(f"[PERF] {line}")
+    if args.output_dir:
+        path = meas.store(args.output_dir)
+        print(f"[PERF] stored {path}")
+
+    bad = (expected is not None and result.matches != expected) or not result.ok
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

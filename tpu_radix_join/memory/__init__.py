@@ -1,0 +1,3 @@
+from tpu_radix_join.memory.pool import Pool
+
+__all__ = ["Pool"]

@@ -1,0 +1,87 @@
+"""Host memory pool bound to the native bump allocator.
+
+Python face of ``native/pool.cc`` — the replacement for ``memory/Pool.{h,cpp}``
+(static region bump allocator, 64B aligned, overflow fallback, reset;
+Pool.cpp:25-79).  ``get_array`` hands out numpy views into pool memory so
+relation staging buffers are allocated once and reused across joins (the
+reference allocates its relations the same way, Relation.cpp:33).
+
+Falls back to plain numpy allocation when no C++ toolchain is present.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from tpu_radix_join.native.build import load
+
+
+class Pool:
+    """Aligned bump allocator over one native region.
+
+    ``Pool(capacity_bytes)`` -> ``Pool::allocate`` (main.cpp:86-88 sizes it at
+    1.1x the relation footprint; callers here choose their own factor).
+    """
+
+    def __init__(self, capacity_bytes: int):
+        self._lib = load()
+        self._handle = None
+        self.capacity = int(capacity_bytes)
+        if self._lib is not None:
+            self._handle = self._lib.pool_create(self.capacity)
+            if not self._handle:
+                raise MemoryError(f"pool_create({self.capacity}) failed")
+        self._fallback_allocs = []
+
+    @property
+    def native(self) -> bool:
+        return self._handle is not None
+
+    def get_array(self, shape, dtype=np.uint32) -> np.ndarray:
+        """A numpy array backed by pool memory (Pool::getMemory).
+
+        The returned array keeps the Pool alive (via its buffer's base), so
+        views never dangle after the Pool object goes out of scope; only an
+        explicit ``reset()``/``close()`` invalidates them.
+        """
+        dtype = np.dtype(dtype)
+        n_bytes = int(np.prod(shape)) * dtype.itemsize
+        if self._handle is None:
+            arr = np.empty(shape, dtype)
+            self._fallback_allocs.append(arr)
+            return arr
+        ptr = self._lib.pool_get_memory(self._handle, n_bytes)
+        if not ptr:
+            raise MemoryError(f"pool_get_memory({n_bytes}) failed")
+        # ctypes array subclass instances accept attributes: pin the Pool to
+        # the buffer object that numpy keeps as the array's base.
+        buf_cls = type("PoolBuf", ((ctypes.c_uint8 * n_bytes),), {})
+        buf = buf_cls.from_address(ptr)
+        buf._pool_keepalive = self
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def used(self) -> int:
+        if self._handle is None:
+            return sum(a.nbytes for a in self._fallback_allocs)
+        return self._lib.pool_used(self._handle)
+
+    def reset(self) -> None:
+        """Rewind (Pool::reset) — previously returned arrays become invalid."""
+        if self._handle is None:
+            self._fallback_allocs.clear()
+        else:
+            self._lib.pool_reset(self._handle)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.pool_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

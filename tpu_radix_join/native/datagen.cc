@@ -1,0 +1,160 @@
+// Multithreaded host-side relation generation.
+//
+// Native replacement for the reference's data/Relation.cpp generators:
+// fillUniqueValues (dense unique keys + shuffle, Relation.cpp:63-73,87-97),
+// fillModuloValues (:75-85), plus the Zipf skew capability of the GPU data
+// model (data/data.hpp:88).  The unique generator implements the same seeded
+// Feistel-network bijection + cycle-walking as the JAX/numpy implementations
+// (data/relation.py) — round keys are supplied by the caller so all three
+// produce bit-identical permutations.  Parallelised with std::thread: every
+// output index is independent, so this scales to 1B-tuple relations where a
+// host Fisher-Yates shuffle (reference style) would serialize.
+
+#include <cmath>
+#include <cstdint>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kFeistelRounds = 6;
+
+struct FeistelParams {
+  std::uint32_t keys[kFeistelRounds];
+  std::uint32_t half_bits;
+  std::uint64_t domain;       // 2**(2*half_bits)
+  std::uint64_t global_size;  // cycle-walk target range
+};
+
+inline std::uint64_t feistel_once(std::uint64_t x, const FeistelParams& fp) {
+  const std::uint64_t mask = (1ull << fp.half_bits) - 1;
+  std::uint64_t l = x >> fp.half_bits;
+  std::uint64_t r = x & mask;
+  for (int i = 0; i < kFeistelRounds; ++i) {
+    // Must match _feistel_round_np / _feistel_jax in data/relation.py:
+    // f = ((r * 0x9E3779B1 + k) ^ (r >> 7)) & mask  (uint32 wrap-around)
+    std::uint64_t f =
+        ((static_cast<std::uint32_t>(r * 0x9E3779B1u + fp.keys[i])) ^ (r >> 7)) &
+        mask;
+    std::uint64_t nl = r;
+    r = (l ^ f) & mask;
+    l = nl;
+  }
+  return (l << fp.half_bits) | r;
+}
+
+inline std::uint64_t permute(std::uint64_t idx, const FeistelParams& fp) {
+  std::uint64_t v = feistel_once(idx, fp);
+  while (v >= fp.global_size) v = feistel_once(v, fp);  // cycle-walk
+  return v;
+}
+
+void run_threads(std::uint64_t count, int num_threads,
+                 const std::function<void(std::uint64_t, std::uint64_t)>& fn) {
+  if (num_threads <= 1) {
+    fn(0, count);
+    return;
+  }
+  std::vector<std::thread> ts;
+  std::uint64_t chunk = (count + num_threads - 1) / num_threads;
+  for (int t = 0; t < num_threads; ++t) {
+    std::uint64_t lo = t * chunk;
+    std::uint64_t hi = lo + chunk < count ? lo + chunk : count;
+    if (lo >= hi) break;
+    ts.emplace_back(fn, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// keys_out[i] = perm(start + i) for a seeded bijection of [0, global_size).
+// round_keys: 6 uint32 Feistel round keys (from the caller's seeded RNG).
+void fill_unique(std::uint32_t* keys_out, std::uint64_t start,
+                 std::uint64_t count, std::uint64_t global_size,
+                 std::uint32_t half_bits, const std::uint32_t* round_keys,
+                 int num_threads) {
+  FeistelParams fp;
+  for (int i = 0; i < kFeistelRounds; ++i) fp.keys[i] = round_keys[i];
+  fp.half_bits = half_bits;
+  fp.domain = 1ull << (2 * half_bits);
+  fp.global_size = global_size;
+  run_threads(count, num_threads, [&](std::uint64_t lo, std::uint64_t hi) {
+    for (std::uint64_t i = lo; i < hi; ++i) {
+      keys_out[i] = static_cast<std::uint32_t>(permute(start + i, fp));
+    }
+  });
+}
+
+// keys_out[i] = (start + i) % modulo  (Relation::fillModuloValues).
+void fill_modulo(std::uint32_t* keys_out, std::uint64_t start,
+                 std::uint64_t count, std::uint32_t modulo, int num_threads) {
+  run_threads(count, num_threads, [&](std::uint64_t lo, std::uint64_t hi) {
+    for (std::uint64_t i = lo; i < hi; ++i) {
+      keys_out[i] = static_cast<std::uint32_t>((start + i) % modulo);
+    }
+  });
+}
+
+// Zipf(theta) draw over [0, domain) via inverse-CDF on a caller-provided
+// rank table (the Python layer builds it so native and numpy paths share the
+// exact float64 table and produce bit-identical keys).  splitmix64 seeded by
+// the *global* tuple index keeps shards/threads independent and the stream
+// deterministic in (seed, index).
+static inline std::uint64_t splitmix64(std::uint64_t x) {
+  x += 0x9E3779B97f4A7C15ull;
+  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
+  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
+  return x ^ (x >> 31);
+}
+
+void fill_zipf(std::uint32_t* keys_out, std::uint64_t start,
+               std::uint64_t count, const double* cdf,
+               std::uint64_t table_size, std::uint64_t domain, double theta,
+               std::uint64_t seed, int num_threads) {
+  const double head = cdf[table_size - 1];
+  // Ranks past the table follow the continuous power-law tail:
+  // integral of x^-(1+theta) over [table_size, domain].
+  const double t_pow = std::pow(static_cast<double>(table_size), -theta);
+  const double d_pow = std::pow(static_cast<double>(domain), -theta);
+  const double tail = domain > table_size ? (t_pow - d_pow) / theta : 0.0;
+  const double total = head + tail;
+  run_threads(count, num_threads, [&](std::uint64_t lo, std::uint64_t hi) {
+    for (std::uint64_t i = lo; i < hi; ++i) {
+      double u =
+          (splitmix64(seed ^ (start + i)) >> 11) * (1.0 / 9007199254740992.0);
+      double target = u * total;
+      if (target > head) {
+        // inverse-CDF of the continuous tail
+        double frac = (target - head) / tail;
+        double x = std::pow(t_pow - frac * (t_pow - d_pow), -1.0 / theta);
+        std::uint64_t k = static_cast<std::uint64_t>(x);
+        if (k < table_size) k = table_size;
+        if (k >= domain) k = domain - 1;
+        keys_out[i] = static_cast<std::uint32_t>(k);
+        continue;
+      }
+      // lower_bound: first rank with cdf >= target (== np.searchsorted left)
+      std::uint64_t a = 0, b = table_size - 1;
+      while (a < b) {
+        std::uint64_t m = (a + b) / 2;
+        if (cdf[m] < target) a = m + 1; else b = m;
+      }
+      keys_out[i] = static_cast<std::uint32_t>(a);
+    }
+  });
+}
+
+void fill_rids(std::uint32_t* rids_out, std::uint64_t start,
+               std::uint64_t count, int num_threads) {
+  run_threads(count, num_threads, [&](std::uint64_t lo, std::uint64_t hi) {
+    for (std::uint64_t i = lo; i < hi; ++i) {
+      rids_out[i] = static_cast<std::uint32_t>(start + i);
+    }
+  });
+}
+
+}  // extern "C"

@@ -33,16 +33,17 @@ import jax.numpy as jnp
 # keys_ok check enforces key <= MAX_MERGE_KEY; violations are routed to the
 # pad values here (no match) and flagged there.
 MAX_MERGE_KEY = 0x7FFFFFFD
-_R_PACK_PAD = jnp.uint32(0xFFFFFFFC)   # key slot 0x7FFFFFFE, tag 0
-_S_PACK_PAD = jnp.uint32(0xFFFFFFFF)   # key slot 0x7FFFFFFF, tag 1
+# Plain ints, not jnp scalars: module import must never initialize a backend.
+_R_PACK_PAD = 0xFFFFFFFC   # key slot 0x7FFFFFFE, tag 0
+_S_PACK_PAD = 0xFFFFFFFF   # key slot 0x7FFFFFFF, tag 1
 
 
 def _pack(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
     one = jnp.uint32(1)
     r_ok = r_keys <= jnp.uint32(MAX_MERGE_KEY)
     s_ok = s_keys <= jnp.uint32(MAX_MERGE_KEY)
-    pr = jnp.where(r_ok, r_keys << one, _R_PACK_PAD)
-    ps = jnp.where(s_ok, (s_keys << one) | one, _S_PACK_PAD)
+    pr = jnp.where(r_ok, r_keys << one, jnp.uint32(_R_PACK_PAD))
+    ps = jnp.where(s_ok, (s_keys << one) | one, jnp.uint32(_S_PACK_PAD))
     return jnp.concatenate([pr, ps])
 
 
