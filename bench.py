@@ -15,6 +15,7 @@ throughput.
 """
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -34,17 +35,43 @@ def main():
     r = jax.block_until_ready(r_rel.shard(0))
     s = jax.block_until_ready(s_rel.shard(0))
 
-    counts = local_join_merge(r, s)
-    matches = int(np.asarray(counts).astype(np.uint64).sum())
-    assert matches == size, (matches, size)
+    from tpu_radix_join.ops.merge_count import merge_count_pallas
 
-    # steady-state timing (compile already cached by the correctness run)
-    iters = 5
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        counts = local_join_merge(r, s)
-    jax.block_until_ready(counts)
-    dt = (time.perf_counter() - t0) / iters
+    def run_xla():
+        return local_join_merge(r, s)
+
+    def run_pallas():
+        return merge_count_pallas(r.key, s.key)
+
+    candidates = [("xla", run_xla)]
+    try:
+        counts = run_pallas()
+        pallas_matches = int(np.asarray(counts).astype(np.uint64).sum())
+        if pallas_matches == size:
+            candidates.append(("pallas", run_pallas))
+        else:
+            # a kernel that runs but miscounts is a correctness regression —
+            # surface it loudly while letting the XLA path carry the bench
+            print(f"WARNING: pallas path miscounts ({pallas_matches} != {size})",
+                  file=sys.stderr)
+    except Exception as e:
+        print(f"note: pallas path unavailable ({type(e).__name__}); using XLA",
+              file=sys.stderr)
+
+    best = None
+    for name, fn in candidates:
+        counts = fn()
+        matches = int(np.asarray(counts).astype(np.uint64).sum())
+        assert matches == size, (name, matches, size)
+        iters = 5
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            counts = fn()
+        jax.block_until_ready(counts)
+        dt_i = (time.perf_counter() - t0) / iters
+        if best is None or dt_i < best[1]:
+            best = (name, dt_i)
+    dt = best[1]
 
     tuples_per_sec = (2 * size) / dt   # both relations processed
     print(json.dumps({

@@ -1,0 +1,87 @@
+"""64-bit key support: hi/lo uint32 lanes through the full pipeline.
+
+The 1B CompressedTuple config (BASELINE.md #5) uses int64 keys; on TPU these
+ride as two uint32 lanes with the probe comparing a packed uint64 sort lane
+(requires jax x64)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_radix_join import HashJoin, JoinConfig
+from tpu_radix_join.data.tuples import TupleBatch, compress, decompress, partition_ids
+
+
+@pytest.fixture
+def x64():
+    old = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    yield
+    jax.config.update("jax_enable_x64", old)
+
+
+def _batch64(keys64: np.ndarray) -> TupleBatch:
+    keys64 = keys64.astype(np.uint64)
+    return TupleBatch(
+        key=jnp.asarray((keys64 & np.uint64(0xFFFFFFFF)).astype(np.uint32)),
+        rid=jnp.arange(len(keys64), dtype=jnp.uint32),
+        key_hi=jnp.asarray((keys64 >> np.uint64(32)).astype(np.uint32)),
+    )
+
+
+def _host_count(r64, s64):
+    rs = np.sort(r64)
+    lo = np.searchsorted(rs, s64, side="left")
+    hi = np.searchsorted(rs, s64, side="right")
+    return int((hi - lo).sum())
+
+
+def test_probe_count_64bit(x64):
+    from tpu_radix_join.ops.build_probe import probe_count
+    rng = np.random.default_rng(0)
+    r64 = (rng.integers(0, 1 << 40, 4000, dtype=np.uint64)
+           | (np.uint64(1) << np.uint64(33)))
+    s64 = rng.choice(r64, 3000)
+    rb, sb = _batch64(r64), _batch64(s64)
+    rc = compress(rb, 0)
+    sc = compress(sb, 0)
+    rc = rc._replace(key_rem_hi=rb.key_hi)
+    sc = sc._replace(key_rem_hi=sb.key_hi)
+    got = int(probe_count(rc, sc))
+    assert got == _host_count(r64, s64)
+
+
+def test_hi_lane_distinguishes_keys(x64):
+    from tpu_radix_join.ops.build_probe import probe_count
+    from tpu_radix_join.data.tuples import CompressedBatch
+    # same low lane, different hi lane: must NOT match
+    r = CompressedBatch(key_rem=jnp.asarray([5], jnp.uint32),
+                        rid=jnp.asarray([0], jnp.uint32),
+                        key_rem_hi=jnp.asarray([1], jnp.uint32))
+    s = CompressedBatch(key_rem=jnp.asarray([5], jnp.uint32),
+                        rid=jnp.asarray([0], jnp.uint32),
+                        key_rem_hi=jnp.asarray([2], jnp.uint32))
+    assert int(probe_count(r, s)) == 0
+
+
+def test_distributed_join_64bit(x64):
+    rng = np.random.default_rng(3)
+    n = 1 << 12
+    r64 = rng.permutation(n).astype(np.uint64) | (np.uint64(1) << np.uint64(35))
+    s64 = rng.permutation(n).astype(np.uint64) | (np.uint64(1) << np.uint64(35))
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=4, key_bits=64)
+    res = HashJoin(cfg).join_arrays(_batch64(r64), _batch64(s64))
+    assert res.ok
+    assert res.matches == n
+
+
+def test_compress_roundtrip_is_exact_64(x64):
+    rng = np.random.default_rng(4)
+    k64 = rng.integers(0, 1 << 50, 1000, dtype=np.uint64)
+    b = _batch64(k64)
+    pid = partition_ids(b, 6)
+    back = decompress(compress(b, 6), pid, 6)
+    got = (np.asarray(back.key_hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(
+        back.key, dtype=np.uint64)
+    np.testing.assert_array_equal(got, k64)

@@ -202,9 +202,14 @@ class Relation:
         lib = _load_native()
         if num_threads <= 0:
             num_threads = min(16, os.cpu_count() or 1)
-        rid = np.arange(lo, hi, dtype=np.uint32)
-        key = np.empty(n, dtype=np.uint32)
-        kp = key.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+        if lib is not None:
+            key = np.empty(n, dtype=np.uint32)
+            kp = key.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
+            rid = np.empty(n, dtype=np.uint32)
+            lib.fill_rids(rid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+                          lo, n, num_threads)
+        else:
+            rid = np.arange(lo, hi, dtype=np.uint32)
 
         if self.kind == "unique":
             domain_bits = max(2, (self.global_size - 1).bit_length())

@@ -44,7 +44,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base seed (reference: srand(1234+nodeId), main.cpp:94)")
     p.add_argument("--output-dir", default=None,
                    help="experiment dir for .perf/.info files (default: none)")
-    p.add_argument("--repeat", type=int, default=1)
+    def positive_int(v):
+        iv = int(v)
+        if iv < 1:
+            raise argparse.ArgumentTypeError("must be >= 1")
+        return iv
+
+    p.add_argument("--repeat", type=positive_int, default=1)
     return p
 
 

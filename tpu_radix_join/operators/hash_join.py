@@ -226,7 +226,9 @@ class HashJoin:
         JPROC execution timer (the reference's phase timers never include
         compilation — there is none at runtime)."""
         n = self.config.num_nodes
-        key = (r.size // n, s.size // n, cap_r, cap_s)
+        key = (r.size // n, s.size // n, cap_r, cap_s,
+               r.key_hi is None, s.key_hi is None,
+               getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         if key not in self._compiled:
             fn = self._pipeline_fn(r.size // n, s.size // n, cap_r, cap_s)
             self._compiled[key] = fn.lower(r, s).compile()

@@ -1,0 +1,66 @@
+"""Out-of-core chunked join: relations larger than device memory.
+
+Replaces the reference's ``LD`` (large data) GPU capability — histograms,
+reorders and probes indexed by ``iterCount`` so relations bigger than GPU
+memory stream through in 128M-tuple chunks (``data/data.hpp:13-20,69-84``;
+``LD`` kernels ``operators/gpu/kernels.cu:563-858``).
+
+TPU design: ``jax.lax.scan`` over probe-side slabs.  The build side is sorted
+once and stays resident in HBM; each scan step counts one outer slab's
+matches with the merge-count discipline against the sorted inner.  Because
+scan reuses one compiled step, HBM working-set per step is
+O(inner + slab) regardless of total outer size — the `lax.scan`-over-slabs
+shape SURVEY.md §5.7 prescribes.  For inner sides that exceed memory as well,
+``chunked_join_grid`` streams both sides (outer scan nested in a Python loop
+over inner chunks, accumulating partial counts — every (i, j) chunk pair is
+probed exactly once, matching the LD kernels' two-level iterCount indexing).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.merge_count import merge_count_chunks
+
+
+@functools.partial(jax.jit, static_argnames=("num_slabs",))
+def _scan_probe(r_keys: jnp.ndarray, s_keys: jnp.ndarray, num_slabs: int):
+    """Counts for s_keys split into ``num_slabs`` slabs, uint32 [num_slabs]."""
+    slabs = s_keys.reshape(num_slabs, -1)
+
+    def step(carry, slab):
+        # per-slab partial counts; chunked uint32 sums stay overflow-safe
+        c = merge_count_chunks(r_keys, slab, num_chunks=1024)
+        return carry, jnp.sum(c, dtype=jnp.uint32)
+
+    _, per_slab = jax.lax.scan(step, jnp.uint32(0), slabs)
+    return per_slab
+
+
+def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
+    """Exact match count streaming the outer side in ``slab_size`` slabs.
+
+    ``slab_size`` must divide the outer size (pad the relation with S
+    sentinels otherwise — the generators always produce pow2-friendly sizes).
+    """
+    n = s.key.shape[0]
+    if n % slab_size:
+        raise ValueError(f"outer size {n} not divisible by slab size {slab_size}")
+    per_slab = _scan_probe(r.key, s.key, n // slab_size)
+    return int(np.asarray(per_slab).astype(np.uint64).sum())
+
+
+def chunked_join_grid(r_chunks, s_chunks, slab_size: int) -> int:
+    """Both sides streamed: iterables of TupleBatch chunks (host-resident);
+    each inner chunk is joined against every outer chunk exactly once."""
+    total = 0
+    for r in r_chunks:
+        for s in s_chunks:
+            total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]))
+    return total

@@ -80,6 +80,23 @@ def merge_count_chunks(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     return jnp.sum(weight.reshape(c, -1), axis=1, dtype=jnp.uint32)
 
 
+def merge_count_pallas(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Match counting with the fused Pallas scan kernel for the post-sort
+    phase (ops/pallas/merge_scan.py): sort + ONE pass instead of sort + ~5
+    XLA scan passes.  Returns uint32 per-tile partial counts (host uint64
+    sum).  Pads to the kernel tile size with the S pack-pad (sorts last,
+    weight 0)."""
+    from tpu_radix_join.ops.pallas.merge_scan import TILE, merge_scan_chunks
+    packed = _pack(r_keys, s_keys)
+    n = packed.shape[0]
+    pad = (-n) % TILE
+    if pad:
+        packed = jnp.concatenate(
+            [packed, jnp.full((pad,), _S_PACK_PAD, jnp.uint32)])
+    return merge_scan_chunks(jnp.sort(packed), interpret=interpret)
+
+
 def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
                               fanout_bits: int) -> jnp.ndarray:
     """Per-network-partition match counts, uint32 [1 << fanout_bits].

@@ -1,0 +1,3 @@
+from tpu_radix_join.ops.pallas.merge_scan import merge_scan_chunks, pallas_available
+
+__all__ = ["merge_scan_chunks", "pallas_available"]

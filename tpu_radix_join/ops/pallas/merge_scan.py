@@ -1,0 +1,130 @@
+"""Pallas TPU kernel: fused merge-count scan.
+
+After the combined sort (ops/merge_count.py), XLA computes the match weights
+with ~5 separate passes over the 2n array (cumsum, shift-compare, cummax,
+elementwise, chunk reduction) — each a full HBM round trip.  This kernel fuses
+them into ONE pass: a sequential grid walks the sorted packed keys tile by
+tile, carrying the running R-count, run base, and previous key in SMEM
+scratch, and emits one uint32 partial match count per tile.
+
+This is the hand-written counterpart of the reference's fused GPU probe
+kernels (probe_count, kernels.cu:423-463): where the GPU kernel chases hash
+buckets per thread, the TPU kernel turns the probe into a carried scan at HBM
+bandwidth.
+
+In-tile layout: tiles are [ROWS, 128] uint32 in VMEM (row-major order of the
+flat sorted array); full-tile scans decompose into a lane scan (axis=1) plus
+an exclusive row-offset scan, all on the VPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+ROWS = 256          # tile = ROWS x 128 uint32 = 128KB VMEM
+LANES = 128
+TILE = ROWS * LANES
+
+
+def pallas_available() -> bool:
+    """True when running on a real TPU backend (else use interpret=True or
+    the XLA fallback in merge_count.py)."""
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def _tile_cumsum(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive cumsum over a [ROWS, 128] tile in flat row-major order."""
+    lane = jnp.cumsum(x, axis=1)
+    row_tot = lane[:, -1:]
+    row_off = jnp.cumsum(row_tot, axis=0) - row_tot   # exclusive over rows
+    return lane + row_off
+
+
+def _tile_cummax(x: jnp.ndarray) -> jnp.ndarray:
+    """Inclusive cummax over a [ROWS, 128] tile in flat row-major order."""
+    lane = jax.lax.cummax(x, axis=1)
+    row_max = lane[:, -1:]
+    row_carry = jax.lax.cummax(row_max, axis=0)
+    # exclusive over rows: shift down one row
+    prev = jnp.concatenate(
+        [jnp.zeros_like(row_carry[:1]), row_carry[:-1]], axis=0)
+    return jnp.maximum(lane, prev)
+
+
+def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        c_r_ref[0] = jnp.uint32(0)
+        base_ref[0] = jnp.uint32(0)
+        prev_key_ref[0] = jnp.uint32(0xFFFFFFFF)   # never equals a real key
+
+    packed = packed_ref[:]                      # [ROWS, 128] uint32
+    one = jnp.uint32(1)
+    key = packed >> one
+    is_s = (packed & one).astype(jnp.uint32)
+    is_r = one - is_s
+
+    carry_c_r = c_r_ref[0]
+    carry_base = base_ref[0]
+    carry_prev = prev_key_ref[0]
+
+    c_r = _tile_cumsum(is_r) + carry_c_r
+
+    # previous key in flat order: shift within rows; row heads take the last
+    # lane of the previous row; the very first element takes the carry.
+    row_last = key[:, -1:]                       # [ROWS, 1]
+    row_heads = jnp.concatenate(
+        [jnp.full_like(row_last[:1], carry_prev), row_last[:-1]], axis=0)
+    prev_key = jnp.concatenate([row_heads, key[:, :-1]], axis=1)
+    run_start = key != prev_key
+
+    base_at_start = jnp.where(run_start, c_r - is_r, jnp.uint32(0))
+    base_run = jnp.maximum(_tile_cummax(base_at_start), carry_base)
+
+    weight = is_s * (c_r - base_run)
+    out_ref[0, 0] = jnp.sum(weight).astype(jnp.uint32)
+
+    c_r_ref[0] = c_r[-1, -1]
+    base_ref[0] = base_run[-1, -1]
+    prev_key_ref[0] = key[-1, -1]
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def merge_scan_chunks(packed_sorted: jnp.ndarray,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Per-tile match counts (uint32 [n / TILE]) for a sorted packed array.
+
+    ``packed_sorted`` must be sorted uint32 with length a multiple of TILE
+    (callers pad with the S pack-pad value 0xFFFFFFFF, which sorts last and
+    contributes zero weight)."""
+    n = packed_sorted.shape[0]
+    if n % TILE:
+        raise ValueError(f"length {n} must be a multiple of {TILE}")
+    num_tiles = n // TILE
+    return pl.pallas_call(
+        _kernel,
+        grid=(num_tiles,),
+        in_specs=[pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((1, 1), lambda t: (t, 0),
+                               memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((num_tiles, 1), jnp.uint32),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.uint32),
+            pltpu.SMEM((1,), jnp.uint32),
+            pltpu.SMEM((1,), jnp.uint32),
+        ],
+        interpret=interpret,
+    )(packed_sorted.reshape(num_tiles * ROWS, LANES)).reshape(num_tiles)
