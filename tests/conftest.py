@@ -1,23 +1,7 @@
-"""Test rig: single-process multi-device CPU mesh.
+"""Test rig: single-process 8-device virtual CPU mesh (the JAX analog of the
+reference's oversubscribed ``mpirun``, SURVEY.md §4 item 5).  Platform-forcing
+mechanics live in tpu_radix_join/utils/platform.py."""
 
-The reference tests multi-node behavior with plain oversubscribed ``mpirun``
-(SURVEY.md §4.5); the JAX analog is 8 virtual CPU devices via
-``--xla_force_host_platform_device_count``.
+from tpu_radix_join.utils.platform import force_host_cpu_devices
 
-The container's sitecustomize imports jax at interpreter start with
-``JAX_PLATFORMS=axon`` (the live-TPU tunnel), which locks the config default
-before this file runs — so we must update jax.config directly, not just the
-environment.  XLA_FLAGS is still read at first backend use, which has not
-happened yet at conftest import time.
-"""
-
-import os
-
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax
-
-jax.config.update("jax_platforms", "cpu")
+force_host_cpu_devices(8, respect_existing=True)

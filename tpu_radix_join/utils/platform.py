@@ -1,0 +1,63 @@
+"""Force the virtual multi-device CPU platform for distributed tests/dry runs.
+
+The reference exercises multi-node behavior with plain oversubscribed
+``mpirun`` (SURVEY.md §4 item 5); the JAX analog is N virtual CPU devices via
+``--xla_force_host_platform_device_count``.  Two container-specific hazards
+make this non-trivial (and are why this lives in one shared helper instead of
+per-site env fiddling):
+
+1. sitecustomize imports jax at interpreter start pinned to the live-TPU
+   tunnel platform, locking the ``jax_platforms`` config *default* — the env
+   var alone is silently ignored, so we must update jax.config directly.
+2. ``XLA_FLAGS`` is only read at first backend use; once any backend is
+   initialized the flag (and the platform switch) can no longer take effect.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_FLAG = "--xla_force_host_platform_device_count"
+
+
+def force_host_cpu_devices(n: int, respect_existing: bool = False) -> None:
+    """Make ``jax.devices()`` return at least ``n`` virtual CPU devices.
+
+    Must run before any JAX backend use in this process; raises RuntimeError
+    with a clear message if a backend already exists and cannot satisfy ``n``.
+    Replaces an existing device-count flag so the caller's ``n`` wins, unless
+    ``respect_existing`` and the env already requests ``>= n`` devices (so
+    e.g. ``XLA_FLAGS=...device_count=16 pytest`` still gets its 16).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    existing = re.search(rf"{_FLAG}=(\d+)", flags)
+    if existing and respect_existing and int(existing.group(1)) >= n:
+        n = int(existing.group(1))
+    if existing:
+        flags = re.sub(rf"{_FLAG}=\d+", f"{_FLAG}={n}", flags)
+    else:
+        flags = f"{flags} {_FLAG}={n}".strip()
+    os.environ["XLA_FLAGS"] = flags
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        already_initialized = bool(xla_bridge._backends)
+    except (ImportError, AttributeError):
+        already_initialized = False
+    jax.config.update("jax_platforms", "cpu")
+    if len(jax.devices()) < n:
+        hint = (
+            "a JAX backend was already initialized in this process, so the "
+            "platform/device-count override could not take effect; call "
+            f"force_host_cpu_devices({n}) before any JAX computation"
+            if already_initialized
+            else "XLA did not honor the device-count flag"
+        )
+        raise RuntimeError(
+            f"needed {n} virtual CPU devices, got {len(jax.devices())}: {hint}"
+        )
