@@ -7,6 +7,12 @@ Workload: the reference's canonical per-node join scaled to one chip —
 20M ⋈ 20M per node, main.cpp:70-71).  Correctness is asserted against the
 unique-key oracle before timing.
 
+Timing methodology: the TPU in this environment sits behind a tunnel where
+``jax.block_until_ready`` returns before execution finishes and a host
+round-trip costs ~30-125ms.  So each candidate is jitted end-to-end, timed
+over enough dispatches that compute dominates, and the clock stops on a real
+host readback (np.asarray) of the final result.
+
 vs_baseline: the reference publishes no numbers (BASELINE.md — published {}),
 so the denominator is 1e9 tuples/sec/accelerator, a nominal figure for the
 reference-era GPU build/probe kernels (sm_60-class, eth.cu) on this workload;
@@ -21,12 +27,21 @@ import time
 import numpy as np
 
 
+def _time_amortized(fn, args, iters=20):
+    """Seconds/iteration: ``iters`` async dispatches closed by one host
+    readback (the only reliable sync through the tunnel)."""
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(out)
+    return (time.perf_counter() - t0) / iters
+
+
 def main():
     import jax
-    import jax.numpy as jnp
     from tpu_radix_join.data.relation import Relation
-    from tpu_radix_join.data.tuples import TupleBatch
-    from tpu_radix_join.ops.local_join import local_join_merge
+    from tpu_radix_join.ops.merge_count import merge_count_chunks, merge_count_pallas
 
     size = 1 << 24               # 16M tuples per side
 
@@ -35,17 +50,10 @@ def main():
     r = jax.block_until_ready(r_rel.shard(0))
     s = jax.block_until_ready(s_rel.shard(0))
 
-    from tpu_radix_join.ops.merge_count import merge_count_pallas
-
-    def run_xla():
-        return local_join_merge(r, s)
-
-    def run_pallas():
-        return merge_count_pallas(r.key, s.key)
-
-    candidates = [("xla", run_xla)]
+    candidates = [("xla", jax.jit(merge_count_chunks))]
+    run_pallas = jax.jit(merge_count_pallas)
     try:
-        counts = run_pallas()
+        counts = run_pallas(r.key, s.key)
         pallas_matches = int(np.asarray(counts).astype(np.uint64).sum())
         if pallas_matches == size:
             candidates.append(("pallas", run_pallas))
@@ -60,17 +68,14 @@ def main():
 
     best = None
     for name, fn in candidates:
-        counts = fn()
-        matches = int(np.asarray(counts).astype(np.uint64).sum())
-        assert matches == size, (name, matches, size)
-        iters = 5
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            counts = fn()
-        jax.block_until_ready(counts)
-        dt_i = (time.perf_counter() - t0) / iters
-        if best is None or dt_i < best[1]:
-            best = (name, dt_i)
+        if name != "pallas":   # pallas was already validated above
+            counts = fn(r.key, s.key)
+            matches = int(np.asarray(counts).astype(np.uint64).sum())
+            assert matches == size, (name, matches, size)
+        dt = _time_amortized(fn, (r.key, s.key))
+        print(f"note: {name}: {dt*1e3:.1f} ms/iter", file=sys.stderr)
+        if best is None or dt < best[1]:
+            best = (name, dt)
     dt = best[1]
 
     tuples_per_sec = (2 * size) / dt   # both relations processed

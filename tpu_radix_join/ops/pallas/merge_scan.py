@@ -42,39 +42,55 @@ def pallas_available() -> bool:
         return False
 
 
+def _iscan(x: jnp.ndarray, op, ident, axis: int) -> jnp.ndarray:
+    """Inclusive Hillis-Steele scan along ``axis`` built from circular roll +
+    iota mask (Mosaic lowers neither the cumsum/cummax primitives nor
+    lane-offset slices, but pltpu.roll is native)."""
+    n = x.shape[axis]
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    sh = 1
+    while sh < n:
+        rolled = pltpu.roll(x, sh, axis=axis)
+        x = op(x, jnp.where(idx >= sh, rolled, ident))
+        sh *= 2
+    return x
+
+
 def _tile_cumsum(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive cumsum over a [ROWS, 128] tile in flat row-major order."""
-    lane = jnp.cumsum(x, axis=1)
-    row_tot = lane[:, -1:]
-    row_off = jnp.cumsum(row_tot, axis=0) - row_tot   # exclusive over rows
+    """Inclusive cumsum over a [ROWS, 128] int32 tile in flat row-major order."""
+    lane = _iscan(x, jnp.add, 0, axis=1)
+    row_tot = jnp.sum(x, axis=1, keepdims=True)
+    row_off = _iscan(row_tot, jnp.add, 0, axis=0) - row_tot   # exclusive
     return lane + row_off
 
 
 def _tile_cummax(x: jnp.ndarray) -> jnp.ndarray:
-    """Inclusive cummax over a [ROWS, 128] tile in flat row-major order."""
-    lane = jax.lax.cummax(x, axis=1)
-    row_max = lane[:, -1:]
-    row_carry = jax.lax.cummax(row_max, axis=0)
+    """Inclusive cummax over a [ROWS, 128] int32 tile in flat row-major order."""
+    lane = _iscan(x, jnp.maximum, 0, axis=1)
+    row_max = jnp.max(x, axis=1, keepdims=True)
+    row_carry = _iscan(row_max, jnp.maximum, 0, axis=0)
     # exclusive over rows: shift down one row
-    prev = jnp.concatenate(
-        [jnp.zeros_like(row_carry[:1]), row_carry[:-1]], axis=0)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, row_carry.shape, 0)
+    prev = jnp.where(row_idx >= 1, pltpu.roll(row_carry, 1, axis=0), 0)
     return jnp.maximum(lane, prev)
 
 
 def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
+    """All arithmetic is int32: Mosaic does not legalize unsigned max or
+    reductions, and every quantity here fits — keys are packed>>1 < 2^31,
+    counts <= n < 2^31.  The prev-key sentinel is -1 (no valid key < 0)."""
     t = pl.program_id(0)
 
     @pl.when(t == 0)
     def _init():
-        c_r_ref[0] = jnp.uint32(0)
-        base_ref[0] = jnp.uint32(0)
-        prev_key_ref[0] = jnp.uint32(0xFFFFFFFF)   # never equals a real key
+        c_r_ref[0] = jnp.int32(0)
+        base_ref[0] = jnp.int32(0)
+        prev_key_ref[0] = jnp.int32(-1)   # never equals a real key
 
     packed = packed_ref[:]                      # [ROWS, 128] uint32
-    one = jnp.uint32(1)
-    key = packed >> one
-    is_s = (packed & one).astype(jnp.uint32)
-    is_r = one - is_s
+    key = (packed >> jnp.uint32(1)).astype(jnp.int32)
+    is_s = (packed & jnp.uint32(1)).astype(jnp.int32)
+    is_r = 1 - is_s
 
     carry_c_r = c_r_ref[0]
     carry_base = base_ref[0]
@@ -82,23 +98,29 @@ def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
 
     c_r = _tile_cumsum(is_r) + carry_c_r
 
-    # previous key in flat order: shift within rows; row heads take the last
-    # lane of the previous row; the very first element takes the carry.
-    row_last = key[:, -1:]                       # [ROWS, 1]
-    row_heads = jnp.concatenate(
-        [jnp.full_like(row_last[:1], carry_prev), row_last[:-1]], axis=0)
-    prev_key = jnp.concatenate([row_heads, key[:, :-1]], axis=1)
+    # previous key in flat row-major order via circular rolls: lane roll
+    # brings key[r, j-1] (and key[r, 127] into lane 0); a row roll on top
+    # fixes lane 0 to key[r-1, 127]; element (0, 0) takes the carry.
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, key.shape, 1)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, key.shape, 0)
+    rl = pltpu.roll(key, 1, axis=1)
+    prev_key = jnp.where(lane_idx == 0, pltpu.roll(rl, 1, axis=0), rl)
+    prev_key = jnp.where((lane_idx == 0) & (row_idx == 0), carry_prev,
+                         prev_key)
     run_start = key != prev_key
 
-    base_at_start = jnp.where(run_start, c_r - is_r, jnp.uint32(0))
+    base_at_start = jnp.where(run_start, c_r - is_r, 0)
     base_run = jnp.maximum(_tile_cummax(base_at_start), carry_base)
 
     weight = is_s * (c_r - base_run)
-    out_ref[0, 0] = jnp.sum(weight).astype(jnp.uint32)
+    out_ref[t, 0] = jnp.sum(weight).astype(jnp.uint32)
 
-    c_r_ref[0] = c_r[-1, -1]
-    base_ref[0] = base_run[-1, -1]
-    prev_key_ref[0] = key[-1, -1]
+    # last flat element of each carry, expressed as a reduction (Mosaic
+    # cannot extract a VMEM scalar): c_r and base_run are nondecreasing in
+    # flat order and keys are sorted, so last == max (or carry + tile sum).
+    c_r_ref[0] = carry_c_r + jnp.sum(is_r)
+    base_ref[0] = jnp.max(base_run)
+    prev_key_ref[0] = jnp.max(key)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -118,13 +140,16 @@ def merge_scan_chunks(packed_sorted: jnp.ndarray,
         grid=(num_tiles,),
         in_specs=[pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((1, 1), lambda t: (t, 0),
+        # full-array SMEM block (one uint32 per tile): the TPU lowering
+        # rejects sub-(8,128) blocks unless they span the whole array, so
+        # every grid step maps the same block and writes its own row.
+        out_specs=pl.BlockSpec((num_tiles, 1), lambda t: (0, 0),
                                memory_space=pltpu.SMEM),
         out_shape=jax.ShapeDtypeStruct((num_tiles, 1), jnp.uint32),
         scratch_shapes=[
-            pltpu.SMEM((1,), jnp.uint32),
-            pltpu.SMEM((1,), jnp.uint32),
-            pltpu.SMEM((1,), jnp.uint32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
         ],
         interpret=interpret,
     )(packed_sorted.reshape(num_tiles * ROWS, LANES)).reshape(num_tiles)
