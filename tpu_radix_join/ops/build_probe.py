@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import CompressedBatch
+from tpu_radix_join.ops.sorting import sort_unstable
 
 
 def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
@@ -55,7 +56,7 @@ def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
 
 def _probe_bounds(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """(sorted r, left bounds, right bounds) for each s key."""
-    r_sorted = jnp.sort(r_keys)
+    r_sorted = sort_unstable(r_keys)
     lo = jnp.searchsorted(r_sorted, s_keys, side="left", method="sort")
     hi = jnp.searchsorted(r_sorted, s_keys, side="right", method="sort")
     return r_sorted, lo, hi

@@ -27,11 +27,12 @@ import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import TupleBatch, partition_ids
 from tpu_radix_join.ops.radix import scatter_to_blocks
+from tpu_radix_join.ops.sorting import sort_unstable
 
 
 def local_join_sorted(r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
     """Total match count (uint32) via sort + dual searchsorted."""
-    r_sorted = jnp.sort(r.key)
+    r_sorted = sort_unstable(r.key)
     lo = jnp.searchsorted(r_sorted, s.key, side="left", method="sort")
     hi = jnp.searchsorted(r_sorted, s.key, side="right", method="sort")
     return jnp.sum((hi - lo).astype(jnp.uint32))
@@ -71,7 +72,7 @@ def local_join_partitioned(
     s_pid = partition_ids(s, fanout_bits)
     r_blocks, _, r_ovf = scatter_to_blocks(r, r_pid, num_p, capacity, "inner")
     s_blocks, _, s_ovf = scatter_to_blocks(s, s_pid, num_p, capacity, "outer")
-    rk = jnp.sort(r_blocks.key.reshape(num_p, capacity), axis=1)
+    rk = sort_unstable(r_blocks.key.reshape(num_p, capacity), dimension=1)
     sk = s_blocks.key.reshape(num_p, capacity)
 
     def row(rrow, srow):

@@ -37,6 +37,10 @@ MAX_MERGE_KEY = 0x7FFFFFFD
 _R_PACK_PAD = 0xFFFFFFFC   # key slot 0x7FFFFFFE, tag 0
 _S_PACK_PAD = 0xFFFFFFFF   # key slot 0x7FFFFFFF, tag 1
 
+# The packed value carries the side tag, so equal values are fully
+# interchangeable and an unstable sort loses nothing (ops/sorting.py).
+from tpu_radix_join.ops.sorting import sort_unstable as _sort_unstable
+
 
 def _pack(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
     one = jnp.uint32(1)
@@ -71,7 +75,7 @@ def merge_count_chunks(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     ``(n/num_chunks)``-position window's weights stay < 2**32 — guaranteed
     when per-key inner multiplicity * chunk width < 2**32 (canonical
     workloads: inner multiplicity ~1)."""
-    packed = jnp.sort(_pack(r_keys, s_keys))
+    packed = _sort_unstable(_pack(r_keys, s_keys))
     weight, _ = _weights(packed)
     n = weight.shape[0]
     c = max(1, num_chunks)
@@ -94,7 +98,7 @@ def merge_count_pallas(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     if pad:
         packed = jnp.concatenate(
             [packed, jnp.full((pad,), _S_PACK_PAD, jnp.uint32)])
-    return merge_scan_chunks(jnp.sort(packed), interpret=interpret)
+    return merge_scan_chunks(_sort_unstable(packed), interpret=interpret)
 
 
 def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
@@ -104,7 +108,7 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     One extra scatter-add pass (bincount) over the sort order; partitions are
     the low key bits so they interleave in sorted order.  Each partition's
     count must stay < 2**32 (SURVEY.md §7.4 item 2 contract)."""
-    packed = jnp.sort(_pack(r_keys, s_keys))
+    packed = _sort_unstable(_pack(r_keys, s_keys))
     weight, key = _weights(packed)
     pid = (key & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
     return jnp.bincount(pid, weights=weight, length=1 << fanout_bits).astype(jnp.uint32)

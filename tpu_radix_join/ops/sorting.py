@@ -1,0 +1,25 @@
+"""Shared sort primitives.
+
+Every hot reorder in the pipeline is an *unstable* ``lax.sort``: the join's
+semantics never depend on the relative order of equal keys (payload lanes
+travel with their key in key-value sorts; probe disciplines are
+order-independent within an equal-key run), and on v5e an unstable sort is
+~2x the speed of the stable sort ``jnp.sort``/``jnp.argsort`` emit (measured
+44.6ms vs 93ms at 32M uint32).  Centralised here so a backend where that
+tradeoff flips needs one edit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sort_unstable(x: jnp.ndarray, dimension: int = -1) -> jnp.ndarray:
+    """Unstable sort of one array along ``dimension``."""
+    return jax.lax.sort([x], dimension=dimension, is_stable=False)[0]
+
+
+def sort_kv_unstable(key: jnp.ndarray, *values: jnp.ndarray):
+    """Unstable key-value sort; returns (sorted key, *values in key order)."""
+    return jax.lax.sort((key, *values), num_keys=1, is_stable=False)
