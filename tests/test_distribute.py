@@ -1,0 +1,76 @@
+"""Tests for the Relation::distribute analog (parallel/distribute.py) on the
+8-virtual-device mesh: conservation, uniform source mixing, and real local
+shuffling — the properties the reference's pairwise exchange establishes
+(Relation.cpp:99-141)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.parallel.distribute import distribute
+from tpu_radix_join.parallel.mesh import make_mesh
+
+N = 8
+LOCAL = 1 << 10
+
+
+def _range_sharded_batch():
+    """The pre-distribute state: node i holds the dense range
+    [i*LOCAL, (i+1)*LOCAL) — what a rank-local generator without the exchange
+    would produce (Relation.cpp:63-73 before main.cpp:101-104)."""
+    key = jnp.arange(N * LOCAL, dtype=jnp.uint32)
+    rid = jnp.arange(N * LOCAL, dtype=jnp.uint32)
+    return TupleBatch(key=key, rid=rid)
+
+
+def _distribute(batch, seed=7):
+    mesh = make_mesh(N)
+    fn = jax.shard_map(
+        lambda b: distribute(b, N, "nodes", seed=seed),
+        mesh=mesh, in_specs=P("nodes"), out_specs=P("nodes"))
+    return jax.jit(fn)(batch)
+
+
+def test_conservation_and_mixing():
+    out = _distribute(_range_sharded_batch())
+    keys = np.asarray(out.key)
+    rids = np.asarray(out.rid)
+    # conservation: the global multiset of tuples is untouched
+    np.testing.assert_array_equal(np.sort(keys), np.arange(N * LOCAL))
+    np.testing.assert_array_equal(np.sort(rids), np.arange(N * LOCAL))
+    # key/rid pairing survives the exchange (key == rid by construction)
+    np.testing.assert_array_equal(keys, rids)
+    # mixing: every node now holds exactly LOCAL/N keys from each source range
+    per_node = keys.reshape(N, LOCAL)
+    for node in range(N):
+        src = per_node[node] // LOCAL
+        counts = np.bincount(src, minlength=N)
+        np.testing.assert_array_equal(counts, np.full(N, LOCAL // N))
+
+
+def test_locally_shuffled_and_seed_dependent():
+    out7 = _distribute(_range_sharded_batch(), seed=7)
+    out8 = _distribute(_range_sharded_batch(), seed=8)
+    k7 = np.asarray(out7.key).reshape(N, LOCAL)
+    k8 = np.asarray(out8.key).reshape(N, LOCAL)
+    for node in range(N):
+        # not sorted (the pre-exchange state was): a real local shuffle ran
+        assert (np.diff(k7[node].astype(np.int64)) < 0).any()
+        # same multiset per node across seeds is not required, but determinism
+        # per seed is:
+    np.testing.assert_array_equal(
+        np.asarray(_distribute(_range_sharded_batch(), seed=7).key), k7.reshape(-1))
+    assert (k7 != k8).any()
+
+
+def test_wide_keys_travel():
+    key = jnp.arange(N * LOCAL, dtype=jnp.uint32)
+    batch = TupleBatch(key=key, rid=key, key_hi=key ^ jnp.uint32(0x5A5A5A5A))
+    out = _distribute(batch)
+    keys = np.asarray(out.key)
+    np.testing.assert_array_equal(np.sort(keys), np.arange(N * LOCAL))
+    # lanes stay aligned
+    np.testing.assert_array_equal(np.asarray(out.key_hi),
+                                  keys ^ np.uint32(0x5A5A5A5A))

@@ -11,11 +11,12 @@ Replaces ``data/Relation.{h,cpp}``:
     key = rid % modulo, giving closed-form match-rate control.
   * ``fill_zipf``    -> the Zipf ``zFactor`` capability of the GPU data model
     (data/data.hpp:88) exercised by the skew benchmark config.
-  * ``Relation.distribute`` -> ``Relation::distribute`` (Relation.cpp:99-141):
-    the reference pairwise-exchanges random blocks so each rank holds a random
-    slice of the key space; here the generator IS globally shuffled (a seeded
-    permutation sharded contiguously), which yields the identical distribution
-    without a network step.
+  * ``Relation::distribute`` (Relation.cpp:99-141): the reference
+    pairwise-exchanges random blocks so each rank holds a random slice of the
+    key space; here the generator IS globally shuffled (a seeded permutation
+    sharded contiguously), so the join pipeline needs no network pre-step.
+    For shards that DO arrive with locality, ``parallel/distribute.py``
+    provides the explicit all_to_all + local-reshuffle equivalent.
 
 TPU-first scale path: host-side ``np.random.permutation`` caps out around a
 few hundred million tuples, so ``fill_unique`` can also run **on device** via a

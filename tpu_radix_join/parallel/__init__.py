@@ -1,5 +1,7 @@
 from tpu_radix_join.parallel.mesh import make_mesh, device_count
 from tpu_radix_join.parallel.window import Window
 from tpu_radix_join.parallel.network_partitioning import network_partition
+from tpu_radix_join.parallel.distribute import distribute
 
-__all__ = ["make_mesh", "device_count", "Window", "network_partition"]
+__all__ = ["make_mesh", "device_count", "Window", "network_partition",
+           "distribute"]

@@ -25,6 +25,19 @@ import jax.numpy as jnp
 from tpu_radix_join.ops.radix import scatter_to_blocks
 
 
+def block_all_to_all(x: jnp.ndarray, num_nodes: int, block: int,
+                     axis_name: str) -> jnp.ndarray:
+    """Dense block exchange: slice ``x``'s leading [num_nodes * block] axis
+    into per-destination blocks and deliver block j to node j.  The single
+    collective that replaces the reference's windowed ``MPI_Put`` schedule
+    (Window.cpp:86-144) and pairwise ``MPI_Send/Recv`` exchange
+    (Relation.cpp:104-136).  Runs inside shard_map over ``axis_name``."""
+    return jax.lax.all_to_all(
+        x.reshape((num_nodes, block) + x.shape[1:]), axis_name,
+        split_axis=0, concat_axis=0, tiled=False,
+    ).reshape((num_nodes * block,) + x.shape[1:])
+
+
 class ExchangeResult(NamedTuple):
     batch: object            # received batch, arrays shaped [N * C]
     recv_counts: jnp.ndarray  # uint32 [N] — valid tuples from each sender
@@ -58,13 +71,8 @@ class Window:
         blocks, counts, overflow = scatter_to_blocks(
             batch, dest, n, c, self.side, valid=valid)
 
-        def a2a(x):
-            return jax.lax.all_to_all(
-                x.reshape((n, c) + x.shape[1:]), self.axis_name,
-                split_axis=0, concat_axis=0, tiled=False,
-            ).reshape((n * c,) + x.shape[1:])
-
-        received = jax.tree.map(a2a, blocks)
+        received = jax.tree.map(
+            lambda x: block_all_to_all(x, n, c, self.axis_name), blocks)
         sent_counts = jnp.minimum(counts, jnp.uint32(c))
         recv_counts = jax.lax.all_to_all(
             sent_counts.reshape(n, 1), self.axis_name, 0, 0).reshape(n)
