@@ -1,0 +1,77 @@
+"""Measurements layer tests: tag registry, .perf round trip, rank-0 style
+aggregation, derived detail counters, and population through a real join
+(SURVEY.md §5.1 parity)."""
+
+import io
+
+from tpu_radix_join import HashJoin, JoinConfig, Relation
+from tpu_radix_join.performance import Measurements, print_results
+from tpu_radix_join.performance import measurements as M
+
+
+def test_store_load_roundtrip(tmp_path):
+    m = Measurements(node_id=3, num_nodes=4)
+    m.start(M.JTOTAL)
+    m.stop(M.JTOTAL)
+    m.incr(M.RESULTS, 42)
+    m.incr(M.RTUPLES, 100)
+    m.incr(M.STUPLES, 100)
+    path = m.store(str(tmp_path))
+    assert path.endswith("3.perf")
+    (loaded,) = Measurements.load(str(tmp_path))
+    assert loaded.node_id == 3
+    assert loaded.counters[M.RESULTS] == 42
+    assert loaded.times_us[M.JTOTAL] == round(m.times_us[M.JTOTAL])
+    # store() derives rates from the counters + JTOTAL
+    assert loaded.counters[M.JRATE] > 0
+
+
+def test_record_exchange_details():
+    m = Measurements()
+    m.record_exchange(num_nodes=8, cap_r=1024, cap_s=2048)
+    # each node ships N blocks per relation (2 relations)
+    assert m.counters[M.MWINPUTCNT] == 16
+    # 8B wire tuples per slot, N blocks of each capacity
+    assert m.counters[M.MWINBYTES] == 8 * 8 * (1024 + 2048)
+    assert m.counters[M.WINCAPR] == 1024
+    assert m.counters[M.WINCAPS] == 2048
+
+
+def test_print_results_aggregates():
+    ms = []
+    for node in range(4):
+        m = Measurements(node_id=node, num_nodes=4)
+        m.times_us[M.JTOTAL] = 100.0 * (node + 1)
+        m.counters[M.RESULTS] = 7
+        ms.append(m)
+    buf = io.StringIO()
+    agg = print_results(ms, file=buf)
+    text = buf.getvalue()
+    assert "[RESULTS] Tuples: 7" in text
+    assert agg[M.JTOTAL]["max"] == 400.0
+    assert agg[M.JTOTAL]["avg"] == 250.0
+
+
+def test_memory_utilization():
+    m = Measurements()
+    mem = m.memory_utilization()
+    # Linux host in this environment: VmSize/VmRSS must parse
+    assert mem.get("VmSize", 0) > 0
+    assert mem.get("VmRSS", 0) > 0
+    assert m.meta["memory"] is mem
+
+
+def test_join_populates_registry():
+    m = Measurements(num_nodes=4)
+    cfg = JoinConfig(num_nodes=4)
+    size = 1 << 12
+    r = Relation(size, 4, "unique", seed=1)
+    s = Relation(size, 4, "unique", seed=2)
+    res = HashJoin(cfg, measurements=m).join(r, s)
+    assert res.matches == size
+    for key in (M.JTOTAL, M.SWINALLOC, M.JPROC):
+        assert m.times_us[key] > 0
+    assert m.counters[M.RESULTS] == size
+    assert m.counters[M.MWINPUTCNT] == 8
+    assert m.counters[M.JRATE] > 0
+    assert m.counters[M.JPROCRATE] >= m.counters[M.JRATE]

@@ -268,6 +268,9 @@ class HashJoin:
             m.incr("RESULTS", matches)
             m.incr("RTUPLES", r.size)
             m.incr("STUPLES", s.size)
+            m.record_exchange(n, cap_r, cap_s,
+                              tuple_bytes=8 if r.key_hi is None else 12)
+            m.derive_rates()
         return JoinResult(matches=matches, ok=bool(ok), partition_counts=counts)
 
     def join(self, inner: Relation, outer: Relation) -> JoinResult:

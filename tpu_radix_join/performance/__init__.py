@@ -1,3 +1,3 @@
-from tpu_radix_join.performance.measurements import Measurements
+from tpu_radix_join.performance.measurements import Measurements, print_results
 
-__all__ = ["Measurements"]
+__all__ = ["Measurements", "print_results"]

@@ -2,8 +2,10 @@
 
 Replaces ``performance/Measurements.{h,cpp}`` (SURVEY.md §5.1): the
 reference's ~60 static start/stop functions around `gettimeofday` + PAPI
-cycles, compile-gated sub-timers, and per-rank ``<rank>.perf`` tag files
-gathered to rank 0.
+cycles, compile-gated sub-timers (``MEASUREMENT_DETAILS_*``), per-rank
+``<rank>.perf`` tag files gathered to rank 0 over MPI_Send/Recv
+(Measurements.cpp:548-590), the printed per-phase table (:592-702), and the
+``/proc/self/status`` memory probe (:825-851).
 
 TPU design: a timer registry keyed by the reference's own tag vocabulary
 (JTOTAL, JHIST, JMPI, JPROC, SWINALLOC, ...) so baseline comparison is
@@ -11,7 +13,12 @@ mechanical; fences are ``jax.block_until_ready`` (device work is async);
 hardware-counter analogs come from ``jax.profiler`` traces rather than PAPI.
 Everything under one jit cannot be phase-timed from the host, so phase timing
 is honest at the granularity the driver actually executes (histogram program /
-join program), with the jit-internal split available via profiler traces.
+join program), with the jit-internal split available via profiler traces
+(:meth:`Measurements.trace`).  The fine-grained *counter* details the
+reference accumulates in its hot loops (tuple sums, per-Put byte/call counts,
+Measurements.cpp:272-349) are exact here without instrumenting the hot path —
+block geometry is static, so the driver derives them from config + results
+(:meth:`Measurements.record_exchange`).
 """
 
 from __future__ import annotations
@@ -21,7 +28,7 @@ import os
 import socket
 import time
 from collections import defaultdict
-from typing import Dict, Optional
+from typing import Dict, Iterable, List, Optional
 
 import jax
 
@@ -33,6 +40,18 @@ JPROC = "JPROC"            # local processing phase
 SWINALLOC = "SWINALLOC"    # window allocation (capacity measurement + compile)
 SNETCOMPL = "SNETCOMPL"    # network completion wait
 SLOCPREP = "SLOCPREP"      # local preparation
+
+# Detail tags (MEASUREMENT_DETAILS_* analogs).  Counters carry the exact
+# quantities the reference sums per call site; rates are derived on report.
+RTUPLES = "RTUPLES"        # inner tuples joined (counter)
+STUPLES = "STUPLES"        # outer tuples joined (counter)
+RESULTS = "RESULTS"        # global match count (RESULT_COUNTER analog)
+MWINPUTCNT = "MWINPUTCNT"  # logical block transfers shuffled (MPI_Put count analog)
+MWINBYTES = "MWINBYTES"    # shuffle wire bytes incl. padding (8B/tuple slots)
+WINCAPR = "WINCAPR"        # per-(sender,dest) block capacity, inner window
+WINCAPS = "WINCAPS"        # per-(sender,dest) block capacity, outer window
+JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
+JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 
 
 class Measurements:
@@ -77,6 +96,58 @@ class Measurements:
     def incr(self, key: str, by: int = 1) -> None:
         self.counters[key] += by
 
+    # ----------------------------------------------------- detail accumulators
+    def record_exchange(self, num_nodes: int, cap_r: int, cap_s: int,
+                        tuple_bytes: int = 8) -> None:
+        """Shuffle-detail counters (MEASUREMENT_DETAILS_NETWORK analog,
+        Measurements.cpp:272-349): the reference counts every 64KB ``MPI_Put``
+        and its bytes in the hot loop; here block geometry is static so the
+        equivalent quantities are derived — per relation, each node ships N
+        blocks of ``capacity`` wire tuples (window.block_all_to_all).
+        ``tuple_bytes``: 8 for two uint32 lanes (the reference's
+        CompressedTuple size), 12 when the key_hi lane travels too."""
+        self.incr(MWINPUTCNT, 2 * num_nodes)
+        self.incr(MWINBYTES, tuple_bytes * num_nodes * (cap_r + cap_s))
+        self.counters[WINCAPR] = cap_r
+        self.counters[WINCAPS] = cap_s
+
+    def derive_rates(self) -> None:
+        """Derived throughput tags (the HILOCRATE/HOLOCRATE pattern,
+        Measurements.cpp:251-260: quantity / sub-phase time)."""
+        tuples = self.counters.get(RTUPLES, 0) + self.counters.get(STUPLES, 0)
+        for rate_key, time_key in ((JRATE, JTOTAL), (JPROCRATE, JPROC)):
+            us = self.times_us.get(time_key, 0.0)
+            if tuples and us > 0:
+                self.counters[rate_key] = int(tuples / (us / 1e6))
+
+    # ------------------------------------------------------- memory / tracing
+    def memory_utilization(self) -> Dict[str, int]:
+        """Host VmSize/VmRSS (printMemoryUtilization parity,
+        Measurements.cpp:825-851) plus per-device HBM stats where the backend
+        exposes them.  Values in bytes; also merged into ``meta``."""
+        out: Dict[str, int] = {}
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith(("VmSize:", "VmRSS:")):
+                        k, v = line.split(":", 1)
+                        out[k] = int(v.split()[0]) * 1024
+        except OSError:   # non-Linux host
+            pass
+        for i, dev in enumerate(jax.local_devices()):
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if stats and "bytes_in_use" in stats:
+                out[f"device{i}_bytes_in_use"] = int(stats["bytes_in_use"])
+        self.meta["memory"] = out
+        return out
+
+    def trace(self, trace_dir: str):
+        """Profiler context (PAPI/CUDA-event analog, Measurements.cpp:90-107 /
+        eth.cu:179-222): wraps ``jax.profiler.trace`` so the jit-internal
+        phase split (histogram/shuffle/probe) is observable even though host
+        timers only see whole programs."""
+        return jax.profiler.trace(trace_dir)
+
     # ---------------------------------------------------------------- output
     def lines(self):
         """Tagged key/value/unit lines in the reference's .perf format
@@ -88,6 +159,7 @@ class Measurements:
 
     def store(self, out_dir: str) -> str:
         """Write ``<rank>.perf`` and ``<rank>.info`` (Measurements.cpp:707-770)."""
+        self.derive_rates()
         os.makedirs(out_dir, exist_ok=True)
         perf = os.path.join(out_dir, f"{self.node_id}.perf")
         with open(perf, "w") as f:
@@ -98,5 +170,50 @@ class Measurements:
         return perf
 
     def summary(self) -> Dict[str, float]:
+        self.derive_rates()
         return {**{k: v for k, v in self.times_us.items()},
                 **{k: float(v) for k, v in self.counters.items()}}
+
+    # ----------------------------------------------------------- aggregation
+    @classmethod
+    def load(cls, out_dir: str) -> List["Measurements"]:
+        """Read every ``<rank>.perf`` in a directory back into registries —
+        the file-based analog of the rank-0 result gather
+        (serializeResults/receiveAllMeasurements, Measurements.cpp:548-590)."""
+        out = []
+        for name in sorted(os.listdir(out_dir)):
+            if not name.endswith(".perf"):
+                continue
+            m = cls(node_id=int(name[:-5]))
+            with open(os.path.join(out_dir, name)) as f:
+                for line in f:
+                    key, value, unit = line.rstrip("\n").split("\t")
+                    if unit == "us":
+                        m.times_us[key] = float(value)
+                    else:
+                        m.counters[key] = int(value)
+            out.append(m)
+        return out
+
+
+def print_results(measurements: Iterable[Measurements],
+                  file=None) -> Dict[str, Dict[str, float]]:
+    """Rank-0 report: per-tag max/avg across nodes plus the ``[RESULTS]``
+    line (printMeasurements, Measurements.cpp:592-702 — the reference prints
+    per-rank phase columns and the total tuple count; max-over-ranks is the
+    number that bounds the critical path in an SPMD phase).  Returns the
+    aggregate dict it printed."""
+    ms = list(measurements)
+    agg: Dict[str, Dict[str, float]] = {}
+    keys = sorted({k for m in ms for k in (*m.times_us, *m.counters)})
+    for k in keys:
+        vals = [m.times_us.get(k, m.counters.get(k, 0)) for m in ms]
+        agg[k] = {"max": float(max(vals)), "avg": float(sum(vals) / len(vals))}
+    print(f"[RESULTS] Nodes: {len(ms)}", file=file)
+    total = sum(m.counters.get(RESULTS, 0) for m in ms) // max(1, len(ms))
+    print(f"[RESULTS] Tuples: {total}", file=file)
+    for k in keys:
+        unit = "us" if any(k in m.times_us for m in ms) else "count"
+        print(f"[RESULTS] {k}: max {agg[k]['max']:.0f} {unit}, "
+              f"avg {agg[k]['avg']:.0f} {unit}", file=file)
+    return agg
