@@ -49,7 +49,8 @@ class JoinConfig:
     payload_bits: int = 27       # rid width contract (Configuration.h:38)
 
     # --- distribution ----------------------------------------------------------
-    num_nodes: int = 1           # mesh size along the "nodes" axis
+    num_nodes: int = 1           # total mesh size (all devices, all hosts)
+    num_hosts: int = 1           # >1 selects the hierarchical (dcn, ici) mesh
     mesh_axis: str = "nodes"
     result_aggregation_node: int = 0
 
@@ -79,6 +80,8 @@ class JoinConfig:
             raise ValueError("key_bits must be 32 or 64")
         if self.num_nodes < 1:
             raise ValueError("num_nodes must be >= 1")
+        if self.num_hosts < 1 or self.num_nodes % self.num_hosts:
+            raise ValueError("num_nodes must divide evenly over num_hosts")
         if self.assignment_policy not in ("round_robin", "load_aware"):
             raise ValueError(f"unknown assignment policy {self.assignment_policy!r}")
         if self.probe_algorithm not in ("sort", "bucket"):
@@ -89,6 +92,14 @@ class JoinConfig:
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
 
     # --- derived geometry ------------------------------------------------------
+    @property
+    def mesh_axes(self):
+        """Axis name(s) the pipeline's collectives run over: the flat
+        ``mesh_axis`` string, or the ``("dcn", "ici")`` pair when the mesh is
+        hierarchical (num_hosts > 1) so the shuffle aggregates cross-host
+        traffic (parallel/window.py)."""
+        return self.mesh_axis if self.num_hosts == 1 else ("dcn", "ici")
+
     @property
     def network_partition_count(self) -> int:
         """NETWORK_PARTITIONING_COUNT = 1 << FANOUT (Configuration.h:33)."""

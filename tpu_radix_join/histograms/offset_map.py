@@ -25,6 +25,8 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from tpu_radix_join.parallel.mesh import AxisName
+
 
 class Offsets(NamedTuple):
     base: jnp.ndarray        # uint32 [P]   start of each partition in owner-order storage
@@ -37,7 +39,7 @@ def compute_offsets(
     local_hist: jnp.ndarray,
     global_hist: jnp.ndarray,
     assignment: jnp.ndarray,
-    axis_name: str,
+    axis_name: AxisName,
 ) -> Offsets:
     """Runs inside shard_map; all shapes static.
 
@@ -54,6 +56,7 @@ def compute_offsets(
     ).astype(jnp.uint32)
 
     all_hists = jax.lax.all_gather(local_hist, axis_name)          # [N, P]
+    all_hists = all_hists.reshape((-1,) + local_hist.shape)        # flatten mesh axes
     my = jax.lax.axis_index(axis_name)
     ranks = jnp.arange(all_hists.shape[0], dtype=jnp.int32)
     relative = jnp.sum(

@@ -26,6 +26,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="tuples per node per relation (reference: 20M, main.cpp:70)")
     p.add_argument("--nodes", type=int, default=0,
                    help="mesh size (0 = all visible devices)")
+    p.add_argument("--hosts", type=int, default=1,
+                   help="hosts in the mesh; >1 builds the hierarchical "
+                        "(dcn, ici) mesh with the two-stage shuffle")
     p.add_argument("--network-fanout", type=int, default=5,
                    help="network radix bits (Configuration.h:30)")
     p.add_argument("--local-fanout", type=int, default=5)
@@ -59,11 +62,14 @@ def main(argv=None) -> int:
 
     import jax
     from tpu_radix_join import HashJoin, JoinConfig, Relation
+    from tpu_radix_join.parallel.multihost import initialize as init_multihost
     from tpu_radix_join.performance import Measurements
 
+    init_multihost()   # no-op unless a multi-process world is configured
     nodes = args.nodes or jax.device_count()
     cfg = JoinConfig(
         num_nodes=nodes,
+        num_hosts=args.hosts,
         network_fanout_bits=args.network_fanout,
         local_fanout_bits=args.local_fanout,
         two_level=args.two_level,

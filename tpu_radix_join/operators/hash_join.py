@@ -44,7 +44,7 @@ from tpu_radix_join.ops.build_probe import (
 )
 from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY, merge_count_per_partition
 from tpu_radix_join.operators.local_partitioning import local_partition
-from tpu_radix_join.parallel.mesh import make_mesh
+from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import network_partition
 from tpu_radix_join.parallel.window import ExchangeResult, Window
 
@@ -73,8 +73,13 @@ class HashJoin:
     def __init__(self, config: JoinConfig, mesh: Optional[Mesh] = None,
                  measurements=None):
         self.config = config
-        self.mesh = mesh if mesh is not None else make_mesh(config.num_nodes,
-                                                            config.mesh_axis)
+        if mesh is not None:
+            self.mesh = mesh
+        elif config.num_hosts > 1:
+            self.mesh = make_hierarchical_mesh(config.num_hosts,
+                                               config.num_nodes)
+        else:
+            self.mesh = make_mesh(config.num_nodes, config.mesh_axis)
         if self.mesh.devices.size != config.num_nodes:
             raise ValueError(
                 f"mesh has {self.mesh.devices.size} devices, config expects "
@@ -95,7 +100,7 @@ class HashJoin:
         conservation invariant regardless of skew (SURVEY.md §7.4 item 1).
         """
         cfg = self.config
-        ax = cfg.mesh_axis
+        ax = cfg.mesh_axes
         n = cfg.num_nodes
         fanout = cfg.network_fanout_bits
 
@@ -113,7 +118,7 @@ class HashJoin:
             s_demand = jnp.sum(jnp.where(dest_onehot, s_hist[None, :], 0), axis=1)
             return r_demand.astype(jnp.uint32), s_demand.astype(jnp.uint32)
 
-        spec = P(cfg.mesh_axis)
+        spec = P(cfg.mesh_axes)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)))
 
@@ -138,7 +143,7 @@ class HashJoin:
     def _pipeline_fn(self, local_size_r: int, local_size_s: int,
                      cap_r: int, cap_s: int):
         cfg = self.config
-        ax = cfg.mesh_axis
+        ax = cfg.mesh_axes
         n = cfg.num_nodes
         fanout = cfg.network_fanout_bits
         num_p = cfg.network_partition_count
@@ -278,7 +283,7 @@ class HashJoin:
         n = self.config.num_nodes
         if inner.num_nodes != n or outer.num_nodes != n:
             raise ValueError("relation num_nodes must match config.num_nodes")
-        sharding = NamedSharding(self.mesh, P(self.config.mesh_axis))
+        sharding = NamedSharding(self.mesh, P(self.config.mesh_axes))
 
         def gather(rel: Relation) -> TupleBatch:
             shards = [rel.shard_np(i) for i in range(n)]

@@ -1,7 +1,13 @@
-from tpu_radix_join.parallel.mesh import make_mesh, device_count
+from tpu_radix_join.parallel.mesh import (
+    device_count,
+    make_hierarchical_mesh,
+    make_mesh,
+)
 from tpu_radix_join.parallel.window import Window
 from tpu_radix_join.parallel.network_partitioning import network_partition
 from tpu_radix_join.parallel.distribute import distribute
+from tpu_radix_join.parallel.multihost import initialize as initialize_multihost
 
-__all__ = ["make_mesh", "device_count", "Window", "network_partition",
-           "distribute"]
+__all__ = ["make_mesh", "make_hierarchical_mesh", "device_count",
+           "Window", "network_partition", "distribute",
+           "initialize_multihost"]

@@ -4,13 +4,28 @@ Replaces the reference's SPMD bootstrap (``MPI_Init`` / ``Comm_size/rank``,
 main.cpp:36-48): on TPU the "cluster" is a ``jax.sharding.Mesh`` over the
 devices visible to the process (multi-host JAX extends this transparently —
 ``jax.devices()`` spans hosts, the direct analog of a multi-node MPI world).
+
+For multi-host pods the mesh can be two-dimensional — ``(dcn, ici)`` — so the
+shuffle's collectives can be laid out hierarchically: bulk all_to_all hops
+ride ICI within each host's slice and only host-aggregated blocks cross DCN
+(parallel/window.py hierarchical exchange).  This is the TPU-native analog of
+the reference's implicit network hierarchy (MPI ranks over an RDMA fabric,
+with foMPI specializing the transport, Window.h:64-68).
 """
 
 from __future__ import annotations
 
+from typing import Sequence, Tuple, Union
+
 import jax
 import numpy as np
 from jax.sharding import Mesh
+
+# Axis argument accepted by the pipeline's collectives: one mesh axis name,
+# or the ("dcn", "ici") pair on a hierarchical mesh.  jax.lax collectives
+# (psum, all_gather, axis_index) take this union directly — axis_index over a
+# tuple is the row-major flat rank, the MPI_Comm_rank analog.
+AxisName = Union[str, Tuple[str, ...]]
 
 
 def device_count() -> int:
@@ -28,3 +43,30 @@ def make_mesh(num_nodes: int | None = None, axis_name: str = "nodes") -> Mesh:
     if n > len(devs):
         raise ValueError(f"requested {n} nodes but only {len(devs)} devices")
     return Mesh(np.asarray(devs[:n]), (axis_name,))
+
+
+def make_hierarchical_mesh(
+    num_hosts: int,
+    num_nodes: int | None = None,
+    axis: Sequence[str] = ("dcn", "ici"),
+) -> Mesh:
+    """A 2-D ``[num_hosts, per_host]`` mesh whose leading axis crosses DCN.
+
+    In a real multi-process job the device grid comes from
+    ``mesh_utils.create_hybrid_device_mesh`` so the leading axis truly follows
+    process (= host) boundaries; single-process (tests, virtual CPU devices)
+    falls back to reshaping the flat device list, which preserves the
+    collective semantics being tested.
+    """
+    devs = jax.devices()
+    n = num_nodes or len(devs)
+    if n % num_hosts:
+        raise ValueError(f"{n} devices do not divide over {num_hosts} hosts")
+    per_host = n // num_hosts
+    if jax.process_count() > 1:
+        from jax.experimental import mesh_utils
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (1, per_host), (num_hosts, 1), devices=devs[:n])
+    else:
+        grid = np.asarray(devs[:n]).reshape(num_hosts, per_host)
+    return Mesh(grid, tuple(axis))

@@ -1,0 +1,64 @@
+"""Multi-process (multi-host) bootstrap: the ``MPI_Init`` analog.
+
+The reference becomes a distributed job by being launched under ``mpirun``
+(main.cpp:36-48: ``MPI_Init`` + ``Comm_size/rank`` discovery).  A multi-host
+JAX job is launched as one process per host with a shared coordinator; after
+``initialize()`` every process sees the whole pod through ``jax.devices()``
+and the same shard_map programs run unchanged — the mesh is the cluster.
+
+The join pipeline needs nothing else: collectives are compiled against mesh
+axes, and ``make_hierarchical_mesh`` (parallel/mesh.py) lays the ``dcn`` axis
+along process boundaries so the shuffle's bulk hops ride ICI.
+
+This module is environment-driven and single-host-safe: with no cluster
+variables set it is a no-op, so every entry point can call it unconditionally
+(the way every reference binary calls ``MPI_Init``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> bool:
+    """Join the multi-process world if one is configured; returns True when
+    running distributed.
+
+    Joining is strictly opt-in: it happens only with an explicit
+    ``coordinator_address`` argument or ``JAX_COORDINATOR_ADDRESS`` in the
+    environment (plus ``JAX_NUM_PROCESSES``/``JAX_PROCESS_ID`` — the moral
+    equivalent of mpirun's rank environment).  Cloud TPU pod launchers that
+    rely on jax's own pod auto-detection should call
+    ``jax.distributed.initialize()`` directly before importing this package;
+    auto-detection is deliberately not replicated here because single-chip
+    tunnel environments carry pod-like variables.
+    """
+    global _initialized
+    if _initialized or jax.distributed.is_initialized():
+        return jax.process_count() > 1
+    env = os.environ
+    coordinator_address = coordinator_address or env.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and "JAX_NUM_PROCESSES" in env:
+        num_processes = int(env["JAX_NUM_PROCESSES"])
+    if process_id is None and "JAX_PROCESS_ID" in env:
+        process_id = int(env["JAX_PROCESS_ID"])
+    if coordinator_address is None:
+        return False   # single-process run; nothing to join
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return jax.process_count() > 1
+
+
+def process_info() -> tuple[int, int]:
+    """(process_id, process_count) — the ``Comm_rank``/``Comm_size`` pair."""
+    return jax.process_index(), jax.process_count()

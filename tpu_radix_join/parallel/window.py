@@ -23,19 +23,55 @@ import jax
 import jax.numpy as jnp
 
 from tpu_radix_join.ops.radix import scatter_to_blocks
+from tpu_radix_join.parallel.mesh import AxisName
 
 
 def block_all_to_all(x: jnp.ndarray, num_nodes: int, block: int,
-                     axis_name: str) -> jnp.ndarray:
+                     axis_name: AxisName) -> jnp.ndarray:
     """Dense block exchange: slice ``x``'s leading [num_nodes * block] axis
     into per-destination blocks and deliver block j to node j.  The single
     collective that replaces the reference's windowed ``MPI_Put`` schedule
     (Window.cpp:86-144) and pairwise ``MPI_Send/Recv`` exchange
-    (Relation.cpp:104-136).  Runs inside shard_map over ``axis_name``."""
+    (Relation.cpp:104-136).  Runs inside shard_map over ``axis_name``; a
+    ``(dcn, ici)`` axis pair selects the hierarchical route."""
+    if not isinstance(axis_name, str):
+        dcn_axis, ici_axis = axis_name
+        return hierarchical_block_all_to_all(x, num_nodes, block,
+                                             dcn_axis, ici_axis)
     return jax.lax.all_to_all(
         x.reshape((num_nodes, block) + x.shape[1:]), axis_name,
         split_axis=0, concat_axis=0, tiled=False,
     ).reshape((num_nodes * block,) + x.shape[1:])
+
+
+def hierarchical_block_all_to_all(x: jnp.ndarray, num_nodes: int, block: int,
+                                  dcn_axis: str, ici_axis: str) -> jnp.ndarray:
+    """Two-stage exchange over a ``[num_hosts, per_host]`` mesh.
+
+    Destination flat id ``d = host(d) * per_host + local(d)``.  Stage 1 rides
+    ICI: within each host, blocks are exchanged so the device at local index
+    ``l`` aggregates everything (from all its host's devices) destined for
+    *any* host's local-``l`` device.  Stage 2 crosses DCN once, between
+    same-local-index peers, shipping per-host-aggregated slabs — N² small
+    messages become H² aggregated ones, which is the point of routing the
+    bulk hops over ICI (SURVEY.md §2.4 TPU mapping; the reference leans on
+    foMPI/DMAPP for the same reason on Cray fabrics, Window.h:64-68).
+
+    Result ordering matches the flat exchange: received blocks are stacked by
+    source flat id (source-host major), so callers cannot tell the routes
+    apart (tested against ``block_all_to_all`` on a flat mesh).
+    """
+    num_hosts = jax.lax.axis_size(dcn_axis)
+    per_host = jax.lax.axis_size(ici_axis)
+    assert num_hosts * per_host == num_nodes
+    v = x.reshape((num_hosts, per_host, block) + x.shape[1:])
+    # Stage 1 (ICI): deliver column l of every destination host to local peer l.
+    v = jax.lax.all_to_all(v, ici_axis, split_axis=1, concat_axis=1,
+                           tiled=False)          # [H_dest, L_src, block]
+    # Stage 2 (DCN): deliver row h (aggregated over the host) to host peer h.
+    v = jax.lax.all_to_all(v, dcn_axis, split_axis=0, concat_axis=0,
+                           tiled=False)          # [H_src, L_src, block]
+    return v.reshape((num_nodes * block,) + x.shape[1:])
 
 
 class ExchangeResult(NamedTuple):
@@ -53,7 +89,8 @@ class Window:
     silently dropped from the accounting).
     """
 
-    def __init__(self, num_nodes: int, capacity: int, axis_name: str, side: str):
+    def __init__(self, num_nodes: int, capacity: int, axis_name: AxisName,
+                 side: str):
         self.num_nodes = num_nodes
         self.capacity = capacity
         self.axis_name = axis_name
@@ -74,8 +111,7 @@ class Window:
         received = jax.tree.map(
             lambda x: block_all_to_all(x, n, c, self.axis_name), blocks)
         sent_counts = jnp.minimum(counts, jnp.uint32(c))
-        recv_counts = jax.lax.all_to_all(
-            sent_counts.reshape(n, 1), self.axis_name, 0, 0).reshape(n)
+        recv_counts = block_all_to_all(sent_counts, n, 1, self.axis_name)
         return ExchangeResult(received, recv_counts, overflow)
 
     def assert_all_tuples_written(
