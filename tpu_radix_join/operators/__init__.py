@@ -1,4 +1,9 @@
-from tpu_radix_join.operators.hash_join import HashJoin, JoinResult
+from tpu_radix_join.operators.hash_join import (
+    HashJoin,
+    JoinResult,
+    MaterializedJoinResult,
+)
 from tpu_radix_join.operators.local_partitioning import local_partition
 
-__all__ = ["HashJoin", "JoinResult", "local_partition"]
+__all__ = ["HashJoin", "JoinResult", "MaterializedJoinResult",
+           "local_partition"]

@@ -35,12 +35,12 @@ from tpu_radix_join.data.tuples import (
 from tpu_radix_join.histograms import (
     compute_global_histogram,
     compute_local_histogram,
-    compute_offsets,
     compute_partition_assignment,
 )
 from tpu_radix_join.ops.build_probe import (
     probe_count_bucketized,
     probe_count_per_partition,
+    probe_materialize,
 )
 from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY, merge_count_per_partition
 from tpu_radix_join.operators.local_partitioning import local_partition
@@ -53,6 +53,15 @@ class JoinResult(NamedTuple):
     matches: int             # exact global match count (host uint64 sum)
     ok: bool                 # conservation invariants held (no overflow, counts conserved)
     partition_counts: np.ndarray  # per-device per-partition (or per-bucket) uint32
+
+
+class MaterializedJoinResult(NamedTuple):
+    """Materialized join output (the probe_match_rate capability,
+    kernels.cu:314-411, end to end): matching rid pairs, globally gathered."""
+    r_rid: np.ndarray        # uint32 [matches]
+    s_rid: np.ndarray        # uint32 [matches]
+    matches: int
+    ok: bool                 # conservation + no per-tuple cap overflow
 
 
 def _as_compressed(batch: TupleBatch) -> CompressedBatch:
@@ -161,28 +170,10 @@ class HashJoin:
             keys_ok = (jnp.max(_sentinel_lane(r)) < key_cap) & (
                 jnp.max(_sentinel_lane(s)) < key_cap)
 
-            # ---- Phase 1: histogram computation (HashJoin.cpp:58-64) ----
-            r_pid, r_hist = compute_local_histogram(r, fanout)
-            s_pid, s_hist = compute_local_histogram(s, fanout)
-            r_ghist = compute_global_histogram(r_hist, ax)
-            s_ghist = compute_global_histogram(s_hist, ax)
-            assignment = compute_partition_assignment(
-                r_ghist, s_ghist, n, cfg.assignment_policy)
-            r_off = compute_offsets(r_hist, r_ghist, assignment, ax)
-            s_off = compute_offsets(s_hist, s_ghist, assignment, ax)
-
-            # ---- Phase 2: window allocation is implicit (static shapes) ----
-            # ---- Phase 3: network partitioning (HashJoin.cpp:98-105) ----
-            rp = network_partition(r, fanout, assignment, win_r)
-            sp = network_partition(s, fanout, assignment, win_s)
-
-            # ---- Phase 4: sync barrier -> implicit in program order ----
-            ok_r = win_r.assert_all_tuples_written(
-                ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
-                r_ghist, assignment)
-            ok_s = win_s.assert_all_tuples_written(
-                ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
-                s_ghist, assignment)
+            # ---- Phases 1-4: histograms, window allocation (implicit in
+            # static shapes), all_to_all shuffle, conservation barrier
+            # (HashJoin.cpp:58-121) — shared with the materialize variant ----
+            rp, sp, ok_shuffle = self._shuffle(r, s, win_r, win_s)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
             if cfg.two_level or cfg.probe_algorithm == "bucket":
@@ -212,7 +203,7 @@ class HashJoin:
                     rp.batch.key, sp.batch.key, fanout)
                 ok_local = jnp.bool_(True)
 
-            ok = ok_r & ok_s & ok_local & keys_ok
+            ok = ok_shuffle & ok_local & keys_ok
             ok_global = jax.lax.psum((~ok).astype(jnp.uint32), ax) == 0
             return counts, ok_global
 
@@ -221,6 +212,59 @@ class HashJoin:
             body, mesh=self.mesh,
             in_specs=(spec, spec),
             out_specs=(spec, P()),
+        ))
+
+    def _shuffle(self, r: TupleBatch, s: TupleBatch,
+                 win_r: Window, win_s: Window):
+        """Phases 1-4 (histograms -> assignment -> all_to_all shuffle ->
+        conservation checks), shared by the counting and materializing
+        pipelines.  Traced inside shard_map."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        fanout = cfg.network_fanout_bits
+        _, r_hist = compute_local_histogram(r, fanout)
+        _, s_hist = compute_local_histogram(s, fanout)
+        r_ghist = compute_global_histogram(r_hist, ax)
+        s_ghist = compute_global_histogram(s_hist, ax)
+        assignment = compute_partition_assignment(
+            r_ghist, s_ghist, cfg.num_nodes, cfg.assignment_policy)
+        rp = network_partition(r, fanout, assignment, win_r)
+        sp = network_partition(s, fanout, assignment, win_s)
+        ok_r = win_r.assert_all_tuples_written(
+            ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
+            r_ghist, assignment)
+        ok_s = win_s.assert_all_tuples_written(
+            ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
+            s_ghist, assignment)
+        return rp, sp, ok_r & ok_s
+
+    def _materialize_fn(self, cap_r: int, cap_s: int):
+        """Pipeline variant that emits rid pairs instead of counts — the
+        distributed realisation of the dormant GPU ``probe_match_rate``
+        capability (kernels.cu:314-411): static [outer_slots * cap] output
+        buffers per device, overflow reported, never silently truncated."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        n = cfg.num_nodes
+        win_r = Window(n, cap_r, ax, "inner")
+        win_s = Window(n, cap_s, ax, "outer")
+
+        def body(r: TupleBatch, s: TupleBatch):
+            keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
+                jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
+            rp, sp, ok_shuffle = self._shuffle(r, s, win_r, win_s)
+            m = probe_materialize(_as_compressed(rp.batch),
+                                  _as_compressed(sp.batch),
+                                  cfg.match_rate_cap)
+            ok = ok_shuffle & keys_ok & (m.overflow == 0)
+            ok_global = jax.lax.psum((~ok).astype(jnp.uint32), ax) == 0
+            return m.r_rid, m.s_rid, m.valid, ok_global
+
+        spec = P(cfg.mesh_axes)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh,
+            in_specs=(spec, spec),
+            out_specs=(spec, spec, spec, P()),
         ))
 
     def _get_compiled(self, r: TupleBatch, s: TupleBatch,
@@ -278,19 +322,46 @@ class HashJoin:
             m.derive_rates()
         return JoinResult(matches=matches, ok=bool(ok), partition_counts=counts)
 
-    def join(self, inner: Relation, outer: Relation) -> JoinResult:
-        """Join two relation specs (generates shards, shards onto the mesh)."""
+    def join_materialize_arrays(self, r: TupleBatch,
+                                s: TupleBatch) -> MaterializedJoinResult:
+        """Full join with materialized rid pairs (vs. the count-only default —
+        the same distinction as the reference's probe_kernel_eth count-only
+        path vs. probe_match_rate, kernels.cu:314-411)."""
         n = self.config.num_nodes
-        if inner.num_nodes != n or outer.num_nodes != n:
+        if r.size % n or s.size % n:
+            raise ValueError("relation sizes must divide the mesh size")
+        cap_r, cap_s = self._measure_capacities(r, s)
+        key = ("mat", r.size // n, s.size // n, cap_r, cap_s,
+               r.key_hi is None, s.key_hi is None,
+               getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
+        if key not in self._compiled:
+            fn = self._materialize_fn(cap_r, cap_s)
+            self._compiled[key] = fn.lower(r, s).compile()
+        r_rid, s_rid, valid, ok = self._compiled[key](r, s)
+        valid = np.asarray(valid)
+        r_rid = np.asarray(r_rid)[valid]
+        s_rid = np.asarray(s_rid)[valid]
+        return MaterializedJoinResult(r_rid=r_rid, s_rid=s_rid,
+                                      matches=int(valid.sum()), ok=bool(ok))
+
+    def _place(self, rel: Relation) -> TupleBatch:
+        """Generate a relation's shards and lay them out over the mesh."""
+        n = self.config.num_nodes
+        if rel.num_nodes != n:
             raise ValueError("relation num_nodes must match config.num_nodes")
         sharding = NamedSharding(self.mesh, P(self.config.mesh_axes))
+        shards = [rel.shard_np(i) for i in range(n)]
+        keys = np.concatenate([k for k, _ in shards])
+        rids = np.concatenate([r for _, r in shards])
+        return TupleBatch(
+            key=jax.device_put(keys, sharding),
+            rid=jax.device_put(rids, sharding))
 
-        def gather(rel: Relation) -> TupleBatch:
-            shards = [rel.shard_np(i) for i in range(n)]
-            keys = np.concatenate([k for k, _ in shards])
-            rids = np.concatenate([r for _, r in shards])
-            return TupleBatch(
-                key=jax.device_put(keys, sharding),
-                rid=jax.device_put(rids, sharding))
+    def join(self, inner: Relation, outer: Relation) -> JoinResult:
+        """Join two relation specs (generates shards, shards onto the mesh)."""
+        return self.join_arrays(self._place(inner), self._place(outer))
 
-        return self.join_arrays(gather(inner), gather(outer))
+    def join_materialize(self, inner: Relation,
+                         outer: Relation) -> MaterializedJoinResult:
+        return self.join_materialize_arrays(self._place(inner),
+                                            self._place(outer))

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import CompressedBatch
-from tpu_radix_join.ops.sorting import sort_unstable
+from tpu_radix_join.ops.sorting import sort_kv_unstable, sort_unstable
 
 
 def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
@@ -119,10 +119,7 @@ def probe_materialize(
     buffer of ``n_outer * cap`` pairs plus an overflow indicator standing in
     for the kernel's retry flag ``pFlag``.
     """
-    rk = _sort_key(inner)
-    order = jnp.argsort(rk)
-    r_sorted = rk[order]
-    r_rid_sorted = inner.rid[order]
+    r_sorted, r_rid_sorted = sort_kv_unstable(_sort_key(inner), inner.rid)
     sk = _sort_key(outer)
     lo = jnp.searchsorted(r_sorted, sk, side="left", method="sort")
     hi = jnp.searchsorted(r_sorted, sk, side="right", method="sort")

@@ -60,6 +60,8 @@ def make_hierarchical_mesh(
     """
     devs = jax.devices()
     n = num_nodes or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} nodes but only {len(devs)} devices")
     if n % num_hosts:
         raise ValueError(f"{n} devices do not divide over {num_hosts} hosts")
     per_host = n // num_hosts
