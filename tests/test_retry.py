@@ -1,0 +1,62 @@
+"""Failure diagnostics + detect-and-retry tests: capacity shortfalls are
+reported with a structured breakdown and, with max_retries > 0, fixed by
+shape respecialization (SURVEY.md §7.4 item 1's detect-and-retry answer to
+runtime-sized windows)."""
+
+from tpu_radix_join import HashJoin, JoinConfig, Relation
+
+
+def _skewed(n=8, size=1 << 13):
+    r = Relation(size, n, "unique", seed=1)
+    s = Relation(size, n, "zipf", zipf_theta=0.75, key_domain=size, seed=3)
+    return r, s
+
+
+def test_overflow_diagnosed():
+    # static sizing with no slack under heavy skew: shuffle blocks overflow
+    cfg = JoinConfig(num_nodes=8, window_sizing="static",
+                     allocation_factor=1.0)
+    r, s = _skewed()
+    res = HashJoin(cfg).join(r, s)
+    assert not res.ok
+    assert res.diagnostics["shuffle_overflow_tuples"] > 0
+    assert res.diagnostics["key_contract_violations"] == 0
+    assert res.diagnostics["conservation_violations"] == 0
+
+
+def test_retry_recovers_exact_count():
+    cfg = JoinConfig(num_nodes=8, window_sizing="static",
+                     allocation_factor=1.0, max_retries=4)
+    r, s = _skewed()
+    res = HashJoin(cfg).join(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == (1 << 13)
+
+
+def test_materialize_rate_cap_retry():
+    # inner side repeats each key 4x; cap 1 forces a match-rate retry
+    n = 4
+    cfg = JoinConfig(num_nodes=n, network_fanout_bits=4, match_rate_cap=1,
+                     max_retries=3)
+    r = Relation(1 << 12, n, "modulo", modulo=1 << 10)
+    s = Relation(1 << 12, n, "unique", seed=5)
+    res = HashJoin(cfg).join_materialize(r, s)
+    assert res.ok, res.diagnostics
+    # outer keys 0..1023 each hit 4 inner duplicates; keys 1024..4095 hit none
+    assert res.matches == (1 << 10) * 4
+
+
+def test_key_contract_violation_not_retried():
+    import jax.numpy as jnp
+    from tpu_radix_join.data.tuples import TupleBatch
+    n = 4
+    cfg = JoinConfig(num_nodes=n, max_retries=3)
+    sz = 1 << 10
+    # keys above the merge packing limit violate the input contract
+    bad = TupleBatch(key=jnp.full((sz,), 0xF0000000, dtype=jnp.uint32),
+                     rid=jnp.arange(sz, dtype=jnp.uint32))
+    good = TupleBatch(key=jnp.arange(sz, dtype=jnp.uint32),
+                      rid=jnp.arange(sz, dtype=jnp.uint32))
+    res = HashJoin(cfg).join_arrays(bad, good)
+    assert not res.ok
+    assert res.diagnostics["key_contract_violations"] > 0

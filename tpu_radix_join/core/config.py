@@ -69,6 +69,9 @@ class JoinConfig:
     probe_algorithm: str = "sort"            # "sort" | "bucket"
     match_rate_cap: int = 8                  # max materialized matches per outer tuple
     chunk_size: Optional[int] = None         # out-of-core probe chunking (LD kernels)
+    max_retries: int = 0                     # capacity-shortfall retries with doubled
+                                             # static shapes (0 = detect only, the
+                                             # reference's abort-on-failure parity)
 
     # --- instrumentation -------------------------------------------------------
     debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
@@ -90,6 +93,8 @@ class JoinConfig:
             raise ValueError("allocation_factor must be >= 1.0")
         if self.window_sizing not in ("measured", "static"):
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
+        if self.max_retries < 0:
+            raise ValueError("max_retries must be >= 0")
 
     # --- derived geometry ------------------------------------------------------
     @property

@@ -53,6 +53,7 @@ class JoinResult(NamedTuple):
     matches: int             # exact global match count (host uint64 sum)
     ok: bool                 # conservation invariants held (no overflow, counts conserved)
     partition_counts: np.ndarray  # per-device per-partition (or per-bucket) uint32
+    diagnostics: Optional[dict] = None   # failure breakdown (see _flags_to_diag)
 
 
 class MaterializedJoinResult(NamedTuple):
@@ -62,6 +63,7 @@ class MaterializedJoinResult(NamedTuple):
     s_rid: np.ndarray        # uint32 [matches]
     matches: int
     ok: bool                 # conservation + no per-tuple cap overflow
+    diagnostics: Optional[dict] = None
 
 
 def _as_compressed(batch: TupleBatch) -> CompressedBatch:
@@ -150,7 +152,7 @@ class HashJoin:
         return cap(r_demand), cap(s_demand)
 
     def _pipeline_fn(self, local_size_r: int, local_size_s: int,
-                     cap_r: int, cap_s: int):
+                     cap_r: int, cap_s: int, local_slack: int = 1):
         cfg = self.config
         ax = cfg.mesh_axes
         n = cfg.num_nodes
@@ -173,7 +175,8 @@ class HashJoin:
             # ---- Phases 1-4: histograms, window allocation (implicit in
             # static shapes), all_to_all shuffle, conservation barrier
             # (HashJoin.cpp:58-121) — shared with the materialize variant ----
-            rp, sp, ok_shuffle = self._shuffle(r, s, win_r, win_s)
+            rp, sp, net_overflow, conserve_bad = self._shuffle(
+                r, s, win_r, win_s)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
             if cfg.two_level or cfg.probe_algorithm == "bucket":
@@ -182,8 +185,8 @@ class HashJoin:
                         "bucketized probe compares the 32-bit key lane only; "
                         "use probe_algorithm='sort' for 64-bit keys")
                 nb = cfg.local_partition_count
-                lcap_r = cfg.bucket_capacity(n * cap_r, nb)
-                lcap_s = cfg.bucket_capacity(n * cap_s, nb)
+                lcap_r = cfg.bucket_capacity(n * cap_r, nb) * local_slack
+                lcap_s = cfg.bucket_capacity(n * cap_s, nb) * local_slack
                 lr = local_partition(rp.batch, rp.valid, fanout,
                                      cfg.local_fanout_bits, lcap_r, "inner")
                 ls = local_partition(sp.batch, sp.valid, fanout,
@@ -191,21 +194,29 @@ class HashJoin:
                 counts = probe_count_bucketized(
                     lr.blocks.key.reshape(nb, lcap_r),
                     ls.blocks.key.reshape(nb, lcap_s))
-                ok_local = (lr.overflow + ls.overflow) == 0
+                local_overflow = lr.overflow + ls.overflow
             elif r.key_hi is not None:
                 # 64-bit keys: searchsorted discipline (uint64 lane, needs x64)
                 counts = probe_count_per_partition(
                     _as_compressed(rp.batch), _as_compressed(sp.batch),
                     sp.pid, num_p)
-                ok_local = jnp.bool_(True)
+                local_overflow = jnp.uint32(0)
             else:
                 counts = merge_count_per_partition(
                     rp.batch.key, sp.batch.key, fanout)
-                ok_local = jnp.bool_(True)
+                local_overflow = jnp.uint32(0)
 
-            ok = ok_shuffle & ok_local & keys_ok
-            ok_global = jax.lax.psum((~ok).astype(jnp.uint32), ax) == 0
-            return counts, ok_global
+            # Failure breakdown, globally reduced (SURVEY.md section 5.3: the
+            # reference aborts on any failure; here every mode is counted so
+            # the driver can distinguish retryable capacity shortfalls from
+            # contract violations).
+            flags = jnp.stack([
+                jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
+                net_overflow.astype(jnp.uint32),
+                conserve_bad.astype(jnp.uint32),
+                jax.lax.psum(local_overflow.astype(jnp.uint32), ax),
+            ])
+            return counts, flags
 
         spec = P(ax)
         return jax.jit(jax.shard_map(
@@ -230,15 +241,18 @@ class HashJoin:
             r_ghist, s_ghist, cfg.num_nodes, cfg.assignment_policy)
         rp = network_partition(r, fanout, assignment, win_r)
         sp = network_partition(s, fanout, assignment, win_s)
-        ok_r = win_r.assert_all_tuples_written(
+        lost_r, bad_r = win_r.diagnostics(
             ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
             r_ghist, assignment)
-        ok_s = win_s.assert_all_tuples_written(
+        lost_s, bad_s = win_s.diagnostics(
             ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
             s_ghist, assignment)
-        return rp, sp, ok_r & ok_s
+        net_overflow = lost_r + lost_s                       # already psum'd
+        conserve_bad = jax.lax.psum(
+            bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)
+        return rp, sp, net_overflow, conserve_bad
 
-    def _materialize_fn(self, cap_r: int, cap_s: int):
+    def _materialize_fn(self, cap_r: int, cap_s: int, rate_cap: int):
         """Pipeline variant that emits rid pairs instead of counts — the
         distributed realisation of the dormant GPU ``probe_match_rate``
         capability (kernels.cu:314-411): static [outer_slots * cap] output
@@ -252,13 +266,17 @@ class HashJoin:
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
                 jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
-            rp, sp, ok_shuffle = self._shuffle(r, s, win_r, win_s)
+            rp, sp, net_overflow, conserve_bad = self._shuffle(
+                r, s, win_r, win_s)
             m = probe_materialize(_as_compressed(rp.batch),
-                                  _as_compressed(sp.batch),
-                                  cfg.match_rate_cap)
-            ok = ok_shuffle & keys_ok & (m.overflow == 0)
-            ok_global = jax.lax.psum((~ok).astype(jnp.uint32), ax) == 0
-            return m.r_rid, m.s_rid, m.valid, ok_global
+                                  _as_compressed(sp.batch), rate_cap)
+            flags = jnp.stack([
+                jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
+                net_overflow.astype(jnp.uint32),
+                conserve_bad.astype(jnp.uint32),
+                jax.lax.psum(m.overflow.astype(jnp.uint32), ax),
+            ])
+            return m.r_rid, m.s_rid, m.valid, flags
 
         spec = P(cfg.mesh_axes)
         return jax.jit(jax.shard_map(
@@ -268,20 +286,42 @@ class HashJoin:
         ))
 
     def _get_compiled(self, r: TupleBatch, s: TupleBatch,
-                      cap_r: int, cap_s: int):
+                      cap_r: int, cap_s: int, local_slack: int = 1):
         """AOT-compiled pipeline executable for these shapes/capacities.
 
         Ahead-of-time ``lower().compile()`` keeps XLA compilation out of the
         JPROC execution timer (the reference's phase timers never include
         compilation — there is none at runtime)."""
         n = self.config.num_nodes
-        key = (r.size // n, s.size // n, cap_r, cap_s,
+        key = (r.size // n, s.size // n, cap_r, cap_s, local_slack,
                r.key_hi is None, s.key_hi is None,
                getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         if key not in self._compiled:
-            fn = self._pipeline_fn(r.size // n, s.size // n, cap_r, cap_s)
+            fn = self._pipeline_fn(r.size // n, s.size // n, cap_r, cap_s,
+                                   local_slack)
             self._compiled[key] = fn.lower(r, s).compile()
         return self._compiled[key]
+
+    @staticmethod
+    def _flags_to_diag(flags: np.ndarray) -> dict:
+        """Failure breakdown from the pipeline's reduced flag vector."""
+        return {
+            "key_contract_violations": int(flags[0]),  # nodes with out-of-range keys
+            "shuffle_overflow_tuples": int(flags[1]),  # block capacity shortfall
+            "conservation_violations": int(flags[2]),  # nodes with misrouted counts
+            "local_overflow": int(flags[3]),           # bucket / match-cap shortfall
+        }
+
+    @staticmethod
+    def _retryable(diag: dict) -> bool:
+        """Capacity shortfalls are fixable with bigger static shapes; key or
+        conservation violations are not (the reference aborts on everything,
+        Debug.h:27-37 — the retry is this framework's shape-specialization
+        answer to runtime-sized windows, SURVEY.md section 7.4 item 1)."""
+        return (diag["shuffle_overflow_tuples"] > 0
+                or diag["local_overflow"] > 0) and (
+                    diag["key_contract_violations"] == 0
+                    and diag["conservation_violations"] == 0)
 
     # ------------------------------------------------------------------- run
     def join_arrays(self, r: TupleBatch, s: TupleBatch) -> JoinResult:
@@ -302,14 +342,29 @@ class HashJoin:
         cap_r, cap_s = self._measure_capacities(r, s)
         if m:
             m.stop("SWINALLOC")
-            m.start("JCOMPILE")
-        fn = self._get_compiled(r, s, cap_r, cap_s)
-        if m:
-            m.stop("JCOMPILE")
-            m.start("JPROC")
-        counts, ok = fn(r, s)
-        if m:
-            m.stop("JPROC", fence=(counts, ok))
+        local_slack = 1
+        for attempt in range(self.config.max_retries + 1):
+            if m:
+                m.start("JCOMPILE")
+            fn = self._get_compiled(r, s, cap_r, cap_s, local_slack)
+            if m:
+                m.stop("JCOMPILE")
+                m.start("JPROC")
+            counts, flags = fn(r, s)
+            if m:
+                m.stop("JPROC", fence=(counts, flags))
+            flags = np.asarray(flags)
+            diag = self._flags_to_diag(flags)
+            if not flags.any() or not self._retryable(diag):
+                break
+            # capacity shortfall: double only the shapes that fell short and
+            # respecialize (detect-and-retry, SURVEY.md section 7.4 item 1)
+            if diag["shuffle_overflow_tuples"]:
+                cap_r, cap_s = 2 * cap_r, 2 * cap_s
+            if diag["local_overflow"]:
+                local_slack *= 2
+            if m:
+                m.incr("RETRIES")
         counts = np.asarray(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
@@ -320,7 +375,8 @@ class HashJoin:
             m.record_exchange(n, cap_r, cap_s,
                               tuple_bytes=8 if r.key_hi is None else 12)
             m.derive_rates()
-        return JoinResult(matches=matches, ok=bool(ok), partition_counts=counts)
+        return JoinResult(matches=matches, ok=not flags.any(),
+                          partition_counts=counts, diagnostics=diag)
 
     def join_materialize_arrays(self, r: TupleBatch,
                                 s: TupleBatch) -> MaterializedJoinResult:
@@ -330,19 +386,54 @@ class HashJoin:
         n = self.config.num_nodes
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
+        m = self.measurements
+        if m:
+            m.start("JTOTAL")
+            m.start("SWINALLOC")
         cap_r, cap_s = self._measure_capacities(r, s)
-        key = ("mat", r.size // n, s.size // n, cap_r, cap_s,
-               r.key_hi is None, s.key_hi is None,
-               getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
-        if key not in self._compiled:
-            fn = self._materialize_fn(cap_r, cap_s)
-            self._compiled[key] = fn.lower(r, s).compile()
-        r_rid, s_rid, valid, ok = self._compiled[key](r, s)
+        if m:
+            m.stop("SWINALLOC")
+        rate_cap = self.config.match_rate_cap
+        for attempt in range(self.config.max_retries + 1):
+            key = ("mat", r.size // n, s.size // n, cap_r, cap_s, rate_cap,
+                   r.key_hi is None, s.key_hi is None,
+                   getattr(r.key, "sharding", None),
+                   getattr(s.key, "sharding", None))
+            if m:
+                m.start("JCOMPILE")
+            if key not in self._compiled:
+                fn = self._materialize_fn(cap_r, cap_s, rate_cap)
+                self._compiled[key] = fn.lower(r, s).compile()
+            if m:
+                m.stop("JCOMPILE")
+                m.start("JPROC")
+            r_rid, s_rid, valid, flags = self._compiled[key](r, s)
+            if m:
+                m.stop("JPROC", fence=(r_rid, flags))
+            flags = np.asarray(flags)
+            diag = self._flags_to_diag(flags)
+            if not flags.any() or not self._retryable(diag):
+                break
+            if diag["shuffle_overflow_tuples"]:
+                cap_r, cap_s = 2 * cap_r, 2 * cap_s
+            if diag["local_overflow"]:        # match-rate cap shortfall
+                rate_cap *= 2
+            if m:
+                m.incr("RETRIES")
         valid = np.asarray(valid)
         r_rid = np.asarray(r_rid)[valid]
         s_rid = np.asarray(s_rid)[valid]
+        if m:
+            m.stop("JTOTAL")
+            m.incr("RESULTS", int(valid.sum()))
+            m.incr("RTUPLES", r.size)
+            m.incr("STUPLES", s.size)
+            m.record_exchange(n, cap_r, cap_s,
+                              tuple_bytes=8 if r.key_hi is None else 12)
+            m.derive_rates()
         return MaterializedJoinResult(r_rid=r_rid, s_rid=s_rid,
-                                      matches=int(valid.sum()), ok=bool(ok))
+                                      matches=int(valid.sum()),
+                                      ok=not flags.any(), diagnostics=diag)
 
     def _place(self, rel: Relation) -> TupleBatch:
         """Generate a relation's shards and lay them out over the mesh."""

@@ -114,16 +114,31 @@ class Window:
         recv_counts = block_all_to_all(sent_counts, n, 1, self.axis_name)
         return ExchangeResult(received, recv_counts, overflow)
 
+    def diagnostics(
+        self, result: ExchangeResult, global_hist: jnp.ndarray,
+        assignment: jnp.ndarray,
+    ):
+        """(overflow_tuples, conservation_bad) — the two failure modes of the
+        shuffle, separated so callers can tell "blocks too small" (retryable
+        with bigger capacity) from "tuples misrouted" (a real bug).
+
+        ``overflow_tuples``: psum of tuples senders dropped for lack of block
+        capacity.  ``conservation_bad``: True iff the receive total differs
+        from the global histogram over this node's assigned partitions
+        (Window.cpp:180-191) *beyond what the overflow explains* — when
+        tuples overflowed, the exact equality is unevaluable, so it is only
+        asserted when overflow is zero."""
+        me = jax.lax.axis_index(self.axis_name).astype(jnp.uint32)
+        expected = jnp.sum(jnp.where(assignment == me, global_hist, 0))
+        lost = jax.lax.psum(result.send_overflow, self.axis_name)
+        conserve_bad = (jnp.sum(result.recv_counts) != expected) & (lost == 0)
+        return lost, conserve_bad
+
     def assert_all_tuples_written(
         self, result: ExchangeResult, global_hist: jnp.ndarray,
         assignment: jnp.ndarray,
     ) -> jnp.ndarray:
-        """Conservation invariant (Window.cpp:180-191 / SURVEY.md §4.3): the
-        tuples received must equal the global histogram summed over this
-        node's assigned partitions, and nothing may have overflowed.
-        Returns a bool scalar (all good)."""
-        me = jax.lax.axis_index(self.axis_name).astype(jnp.uint32)
-        expected = jnp.sum(jnp.where(assignment == me, global_hist, 0))
-        got = jnp.sum(result.recv_counts)
-        no_overflow = jax.lax.psum(result.send_overflow, self.axis_name) == 0
-        return (got == expected) & no_overflow
+        """Combined invariant (conservation AND zero overflow) — the exact
+        contract of the reference's assert (SURVEY.md §4.3)."""
+        lost, bad = self.diagnostics(result, global_hist, assignment)
+        return (lost == 0) & ~bad
