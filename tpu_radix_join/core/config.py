@@ -95,6 +95,12 @@ class JoinConfig:
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.chunk_size is not None and (
+                self.chunk_size < 1
+                or self.two_level or self.probe_algorithm == "bucket"):
+            raise ValueError(
+                "chunk_size requires the sort probe (chunking bounds the "
+                "probe working set; the bucketized path is already blocked)")
 
     # --- derived geometry ------------------------------------------------------
     @property

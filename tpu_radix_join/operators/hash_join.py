@@ -39,6 +39,7 @@ from tpu_radix_join.histograms import (
 )
 from tpu_radix_join.ops.build_probe import (
     probe_count_bucketized,
+    probe_count_chunked,
     probe_count_per_partition,
     probe_materialize,
 )
@@ -167,7 +168,8 @@ class HashJoin:
             # when the merge probe is the branch in use.  Violations flip `ok`
             # rather than silently overcounting against padding slots.
             uses_merge = (r.key_hi is None and not cfg.two_level
-                          and cfg.probe_algorithm != "bucket")
+                          and cfg.probe_algorithm != "bucket"
+                          and not cfg.chunk_size)
             key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
             keys_ok = (jnp.max(_sentinel_lane(r)) < key_cap) & (
                 jnp.max(_sentinel_lane(s)) < key_cap)
@@ -195,6 +197,12 @@ class HashJoin:
                     lr.blocks.key.reshape(nb, lcap_r),
                     ls.blocks.key.reshape(nb, lcap_s))
                 local_overflow = lr.overflow + ls.overflow
+            elif cfg.chunk_size:
+                # out-of-core discipline (LD kernels): outer slabs under scan
+                counts = probe_count_chunked(
+                    _as_compressed(rp.batch), _as_compressed(sp.batch),
+                    sp.pid, num_p, cfg.chunk_size)
+                local_overflow = jnp.uint32(0)
             elif r.key_hi is not None:
                 # 64-bit keys: searchsorted discipline (uint64 lane, needs x64)
                 counts = probe_count_per_partition(
@@ -386,6 +394,10 @@ class HashJoin:
         n = self.config.num_nodes
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
+        if self.config.chunk_size:
+            raise NotImplementedError(
+                "materializing probe has no chunked variant; unset chunk_size "
+                "(the count path honors it)")
         m = self.measurements
         if m:
             m.start("JTOTAL")

@@ -36,7 +36,7 @@ from typing import NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from tpu_radix_join.data.tuples import CompressedBatch
+from tpu_radix_join.data.tuples import CompressedBatch, pad_sentinel
 from tpu_radix_join.ops.sorting import sort_kv_unstable, sort_unstable
 
 
@@ -73,6 +73,18 @@ def probe_count(inner: CompressedBatch, outer: CompressedBatch) -> jnp.ndarray:
     return jnp.sum((hi - lo).astype(jnp.uint32))
 
 
+def _per_partition_counts(r_sorted: jnp.ndarray, s_keys: jnp.ndarray,
+                          pid: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
+    """Dual searchsorted against the sorted inner + pid-bincount: the shared
+    counting core of the resident and chunked probes."""
+    lo = jnp.searchsorted(r_sorted, s_keys, side="left", method="sort")
+    hi = jnp.searchsorted(r_sorted, s_keys, side="right", method="sort")
+    per_s = (hi - lo).astype(jnp.uint32)
+    return jnp.bincount(
+        pid.astype(jnp.int32), weights=per_s, length=num_partitions
+    ).astype(jnp.uint32)
+
+
 def probe_count_per_partition(
     inner: CompressedBatch, outer: CompressedBatch,
     outer_pid: jnp.ndarray, num_partitions: int,
@@ -82,11 +94,52 @@ def probe_count_per_partition(
     Keeps each accumulator < 2**32 so host-side uint64 summation is exact even
     at billions of total matches (see module docstring).
     """
-    _, lo, hi = _probe_bounds(_sort_key(inner), _sort_key(outer))
-    per_s = (hi - lo).astype(jnp.uint32)
-    return jnp.bincount(
-        outer_pid.astype(jnp.int32), weights=per_s, length=num_partitions
-    ).astype(jnp.uint32)
+    return _per_partition_counts(sort_unstable(_sort_key(inner)),
+                                 _sort_key(outer), outer_pid, num_partitions)
+
+
+def probe_count_chunked(
+    inner: CompressedBatch, outer: CompressedBatch,
+    outer_pid: jnp.ndarray, num_partitions: int, slab_size: int,
+) -> jnp.ndarray:
+    """Per-partition counts with the outer side streamed in ``slab_size``
+    slabs under ``lax.scan`` — the distributed realisation of the reference's
+    LD (large-data) chunked probe (``iterCount``-indexed kernels,
+    kernels.cu:778-856; data.hpp:13-20): the inner side is sorted once and
+    stays resident; per-step working set is O(inner + slab) regardless of
+    the outer buffer size.
+
+    Identical results to :func:`probe_count_per_partition` (tested); the
+    outer buffer is padded to a slab multiple with S-side sentinels, which
+    match nothing by the pad-key contract (tuples.py).
+    """
+    r_sorted = sort_unstable(_sort_key(inner))
+    sk = _sort_key(outer)
+    n = sk.shape[0]
+    pad = (-n) % slab_size
+    if pad:
+        # 64-bit sort keys pad BOTH lanes with the sentinel (the
+        # make_padding(wide=True) contract): 0x00000000_FFFFFFFF would be a
+        # legal real key.
+        fill = int(pad_sentinel("outer"))
+        if outer.key_rem_hi is not None:
+            fill = (fill << 32) | fill
+        sk = jnp.concatenate([sk, jnp.full((pad,), fill, sk.dtype)])
+        outer_pid = jnp.concatenate(
+            [outer_pid, jnp.zeros((pad,), outer_pid.dtype)])
+    slabs = sk.reshape(-1, slab_size)
+    pids = outer_pid.reshape(-1, slab_size)
+
+    def step(carry, slab):
+        keys, pid = slab
+        # carry stays empty: emitting per-slab counts (summed below) keeps the
+        # accumulator's sharding derived from the inputs, which an unvarying
+        # zeros-carry would violate inside shard_map.
+        return carry, _per_partition_counts(r_sorted, keys, pid,
+                                            num_partitions)
+
+    _, per_slab = jax.lax.scan(step, (), (slabs, pids))
+    return jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
 
 
 def probe_count_bucketized(
