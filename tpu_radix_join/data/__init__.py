@@ -1,4 +1,5 @@
 from tpu_radix_join.data.tuples import TupleBatch, CompressedBatch
 from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.streaming import stream_chunks
 
-__all__ = ["TupleBatch", "CompressedBatch", "Relation"]
+__all__ = ["TupleBatch", "CompressedBatch", "Relation", "stream_chunks"]

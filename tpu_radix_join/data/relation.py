@@ -191,26 +191,39 @@ class Relation:
         return self.global_size // self.num_nodes
 
     # ------------------------------------------------------------------ host
-    def shard_np(self, node: int, num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys, rids) as numpy uint32 arrays for one node's shard.
+    def fill_np(self, start: int, count: int, num_threads: int = 0,
+                out_key: Optional[np.ndarray] = None,
+                out_rid: Optional[np.ndarray] = None,
+                ) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, rids) for the global index range [start, start+count).
 
         Uses the native multithreaded generators (native/datagen.cc) when the
         toolchain produced the shared library; the numpy fallbacks are
-        bit-identical (same Feistel rounds / same Zipf table + hashing)."""
-        lo = node * self.local_size
-        hi = lo + self.local_size
-        n = self.local_size
+        bit-identical (same Feistel rounds / same Zipf table + hashing).
+        ``out_key``/``out_rid`` (uint32 [count], e.g. memory-pool views from
+        ``memory.Pool.get_array``) are filled in place when given — the
+        streaming loader reuses two such buffer pairs for arbitrarily large
+        relations (data/streaming.py)."""
+        lo, n = int(start), int(count)
         lib = _load_native()
         if num_threads <= 0:
             num_threads = min(16, os.cpu_count() or 1)
+
+        def buf(out):
+            if out is None:
+                return np.empty(n, dtype=np.uint32)
+            if (out.shape != (n,) or out.dtype != np.uint32
+                    or not out.flags.c_contiguous):
+                raise ValueError(f"out buffer must be contiguous uint32 [{n}]")
+            return out
+
+        key, rid = buf(out_key), buf(out_rid)
         if lib is not None:
-            key = np.empty(n, dtype=np.uint32)
             kp = key.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32))
-            rid = np.empty(n, dtype=np.uint32)
             lib.fill_rids(rid.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                           lo, n, num_threads)
         else:
-            rid = np.arange(lo, hi, dtype=np.uint32)
+            rid[:] = np.arange(lo, lo + n, dtype=np.uint32)
 
         if self.kind == "unique":
             domain_bits = max(2, (self.global_size - 1).bit_length())
@@ -221,18 +234,20 @@ class Relation:
                     rk.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
                     num_threads)
                 return key, rid
-            idx = np.arange(lo, hi, dtype=np.uint64)
-            key = feistel_permutation_np(idx, domain_bits, self.seed)
-            while (key >= self.global_size).any():
-                out = key >= self.global_size
-                key[out] = feistel_permutation_np(key[out], domain_bits, self.seed)
-            return key.astype(np.uint32), rid
+            idx = np.arange(lo, lo + n, dtype=np.uint64)
+            k = feistel_permutation_np(idx, domain_bits, self.seed)
+            while (k >= self.global_size).any():
+                out = k >= self.global_size
+                k[out] = feistel_permutation_np(k[out], domain_bits, self.seed)
+            key[:] = k.astype(np.uint32)
+            return key, rid
 
         if self.kind == "modulo":
             if lib is not None:
                 lib.fill_modulo(kp, lo, n, self.modulo, num_threads)
                 return key, rid
-            return (rid % np.uint32(self.modulo)).astype(np.uint32), rid
+            key[:] = rid % np.uint32(self.modulo)
+            return key, rid
 
         # zipf: skewed draw over [0, key_domain)
         cdf = zipf_cdf_table(self.zipf_theta, self.key_domain)
@@ -242,8 +257,14 @@ class Relation:
                 len(cdf), self.key_domain, ctypes.c_double(self.zipf_theta),
                 self.seed, num_threads)
             return key, rid
-        return zipf_keys_np(lo, n, cdf, self.key_domain, self.zipf_theta,
-                            self.seed), rid
+        key[:] = zipf_keys_np(lo, n, cdf, self.key_domain, self.zipf_theta,
+                              self.seed)
+        return key, rid
+
+    def shard_np(self, node: int, num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+        """(keys, rids) as numpy uint32 arrays for one node's shard."""
+        return self.fill_np(node * self.local_size, self.local_size,
+                            num_threads)
 
     # ---------------------------------------------------------------- device
     def shard(self, node: int) -> TupleBatch:

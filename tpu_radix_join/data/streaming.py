@@ -1,0 +1,88 @@
+"""Streaming relation loader: pool-backed, prefetching chunk generation.
+
+The reference's large-data (LD) path assumes the host feeds the accelerator in
+chunks while the previous chunk computes — its drivers overlap H2D copies with
+kernels on multiple CUDA streams (``small_data.cu:85-159``) and its relations
+live in Pool memory (``Relation.cpp:33``).  The TPU-host analog here:
+
+  * chunk buffers come from the native bump-pool allocator
+    (``memory/pool.py`` -> ``native/pool.cc``) — two pairs, reused for the
+    whole stream, so host memory stays O(chunk) for arbitrarily large
+    relations;
+  * generation of chunk ``k+1`` runs on a background thread (which itself
+    fans out over ``std::thread`` workers in ``native/datagen.cc``) while
+    chunk ``k`` is transferred and consumed — the host-side copy/compute
+    overlap the reference gets from stream double-buffering;
+  * each yielded ``TupleBatch`` holds *device* arrays, transferred and fenced
+    before the backing buffer is handed back to the filler, so buffer reuse
+    can never corrupt an in-flight chunk.
+
+Feeds ``ops/chunked.chunked_join_grid`` (both-sides-streamed joins) and any
+driver that wants relations larger than host or device memory.
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Iterator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.memory.pool import Pool
+
+
+def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
+                  pool: Optional[Pool] = None,
+                  num_threads: int = 0) -> Iterator[TupleBatch]:
+    """Yield one node's shard as device TupleBatches of ``chunk_tuples``
+    (final chunk may be short), generated with double-buffered prefetch.
+
+    ``pool``: optional ``memory.Pool`` to draw the four chunk buffers from
+    (it needs ``8 * 2 * chunk_tuples`` bytes + 64B-alignment headroom);
+    default is a private pool sized exactly for that.
+    """
+    if chunk_tuples < 1:
+        raise ValueError("chunk_tuples must be >= 1")
+    local = rel.local_size
+    base = node * local
+    num_chunks = -(-local // chunk_tuples)
+    own_pool = pool is None
+    if own_pool:
+        pool = Pool(2 * 2 * chunk_tuples * 4 + 4 * 64)
+    bufs = [(pool.get_array((chunk_tuples,)), pool.get_array((chunk_tuples,)))
+            for _ in range(2)]
+
+    def fill(i: int) -> int:
+        start = base + i * chunk_tuples
+        n = min(chunk_tuples, base + local - start)
+        key_buf, rid_buf = bufs[i % 2]
+        rel.fill_np(start, n, num_threads=num_threads,
+                    out_key=key_buf[:n], out_rid=rid_buf[:n])
+        return n
+
+    ex = ThreadPoolExecutor(max_workers=1)
+    try:
+        fut = ex.submit(fill, 0)
+        for i in range(num_chunks):
+            n = fut.result()
+            if i + 1 < num_chunks:
+                # prefetch immediately: fill(i+1) writes bufs[(i+1) % 2],
+                # whose previous chunk was copied and fenced last iteration,
+                # so generation overlaps this chunk's transfer.
+                fut = ex.submit(fill, i + 1)
+            key_buf, rid_buf = bufs[i % 2]
+            # copy=True: on the CPU backend jnp.asarray would zero-copy-alias
+            # the pool buffer, and the fence below must guarantee the chunk
+            # is independent of the buffer before fill(i+2) rewrites it.
+            key = jnp.array(key_buf[:n], copy=True)
+            rid = jnp.array(rid_buf[:n], copy=True)
+            jax.block_until_ready((key, rid))
+            yield TupleBatch(key=key, rid=rid)
+    finally:
+        ex.shutdown(wait=True)
+        if own_pool:
+            pool.close()

@@ -58,7 +58,14 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
 
 def chunked_join_grid(r_chunks, s_chunks, slab_size: int) -> int:
     """Both sides streamed: iterables of TupleBatch chunks (host-resident);
-    each inner chunk is joined against every outer chunk exactly once."""
+    each inner chunk is joined against every outer chunk exactly once.
+
+    ``s_chunks`` is consumed once per inner chunk, so a one-shot iterator
+    (e.g. ``data/streaming.stream_chunks``) is materialized up front — a
+    silently-exhausted generator would drop every outer chunk after the
+    first inner one."""
+    if not isinstance(s_chunks, (list, tuple)):
+        s_chunks = list(s_chunks)
     total = 0
     for r in r_chunks:
         for s in s_chunks:
