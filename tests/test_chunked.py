@@ -31,10 +31,9 @@ def test_chunked_grid_both_sides():
     assert chunked_join_grid(r_chunks, s_chunks, 1 << 10) == expect
 
 
-def test_chunked_indivisible_slab_rejected():
-    import pytest
-    with pytest.raises(ValueError):
-        chunked_join_count(_batch([1, 2, 3]), _batch([1, 2, 3]), 2)
+def test_chunked_indivisible_slab_padded():
+    # ragged outer sizes are sentinel-padded to a slab multiple, not rejected
+    assert chunked_join_count(_batch([1, 2, 3]), _batch([1, 2, 3]), 2) == 3
 
 
 def test_chunked_unique_oracle():

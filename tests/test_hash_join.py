@@ -115,3 +115,21 @@ def test_round_robin_vs_load_aware_same_result():
     m1 = _run(JoinConfig(num_nodes=4), r, s).matches
     m2 = _run(JoinConfig(num_nodes=4, assignment_policy="load_aware"), r, s).matches
     assert m1 == m2 == size
+
+
+def test_debug_checks_per_partition_invariant():
+    """debug_checks turns on the strong per-partition conservation form; a
+    healthy join must still pass it, skewed or not."""
+    cfg = JoinConfig(num_nodes=8, debug_checks=True)
+    size = 1 << 14
+    res = _run(cfg, Relation(size, 8, "unique", seed=1),
+               Relation(size, 8, "unique", seed=9))
+    assert res.ok
+    assert res.matches == size
+    cfg = JoinConfig(num_nodes=8, debug_checks=True,
+                     assignment_policy="load_aware", allocation_factor=4.0)
+    res = _run(cfg, Relation(size, 8, "unique", seed=1),
+               Relation(size, 8, "zipf", zipf_theta=0.75, key_domain=size,
+                        seed=3))
+    assert res.ok
+    assert res.matches == size

@@ -29,3 +29,13 @@ def test_cli_measurement_tags(capsys):
     out = capsys.readouterr().out
     for tag in ("JTOTAL", "JPROC", "SWINALLOC", "RESULTS", "RTUPLES"):
         assert tag in out
+
+
+def test_cli_new_flags(capsys):
+    from tpu_radix_join.main import main
+    rc = main(["--tuples-per-node", "4096", "--nodes", "8",
+               "--chunk-size", "1024", "--max-retries", "2",
+               "--debug-checks"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "Conservation: OK" in out

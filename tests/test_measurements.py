@@ -75,3 +75,15 @@ def test_join_populates_registry():
     assert m.counters[M.MWINPUTCNT] == 8
     assert m.counters[M.JRATE] > 0
     assert m.counters[M.JPROCRATE] >= m.counters[M.JRATE]
+
+
+def test_profiler_trace_smoke(tmp_path):
+    """Measurements.trace (the PAPI/CUDA-event analog) must produce a
+    profiler artifact around device work."""
+    import glob
+    import jax.numpy as jnp
+    m = Measurements()
+    with m.trace(str(tmp_path)):
+        jnp.arange(1024).sum().block_until_ready()
+    assert glob.glob(str(tmp_path) + "/**/*.pb*", recursive=True) or \
+        glob.glob(str(tmp_path) + "/**/*.json*", recursive=True)

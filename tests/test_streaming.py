@@ -56,3 +56,16 @@ def test_streamed_grid_join_oracle():
         list(stream_chunks(s, 0, 1 << 11)),     # outer streamed
         slab_size=1 << 10)
     assert total == size
+
+
+def test_streamed_grid_join_factory_ragged():
+    """Factory form: outer re-streamed per inner chunk (O(chunk) device
+    memory) with ragged chunk and slab sizes."""
+    size = 1 << 13
+    r = Relation(size, 1, "unique", seed=1)
+    s = Relation(size, 1, "unique", seed=2)
+    total = chunked_join_grid(
+        list(stream_chunks(r, 0, 3000)),              # ragged inner chunks
+        lambda: stream_chunks(s, 0, 1500),            # ragged outer, factory
+        slab_size=1024)                               # non-dividing slab
+    assert total == size

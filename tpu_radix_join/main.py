@@ -39,6 +39,14 @@ def build_parser() -> argparse.ArgumentParser:
                    default="round_robin")
     p.add_argument("--window-sizing", choices=["measured", "static"],
                    default="measured")
+    p.add_argument("--chunk-size", type=int, default=None,
+                   help="stream the probe in slabs of this many tuples "
+                        "(out-of-core LD mode)")
+    p.add_argument("--max-retries", type=int, default=0,
+                   help="capacity-shortfall retries with doubled shapes")
+    p.add_argument("--debug-checks", action="store_true",
+                   help="per-partition conservation invariants "
+                        "(JOIN_ASSERT analog; extra passes)")
     p.add_argument("--outer-kind", choices=["unique", "modulo", "zipf"],
                    default="unique")
     p.add_argument("--modulo", type=int, default=None)
@@ -76,6 +84,9 @@ def main(argv=None) -> int:
         probe_algorithm=args.probe,
         assignment_policy=args.assignment,
         window_sizing=args.window_sizing,
+        chunk_size=args.chunk_size,
+        max_retries=args.max_retries,
+        debug_checks=args.debug_checks,
     )
     global_size = args.tuples_per_node * nodes
     inner = Relation(global_size, nodes, "unique", seed=args.seed)
@@ -104,6 +115,9 @@ def main(argv=None) -> int:
         status = "OK" if result.matches == expected else "MISMATCH"
         print(f"[RESULTS] Expected: {expected} ({status})")
     print(f"[RESULTS] Conservation: {'OK' if result.ok else 'VIOLATED'}")
+    if not result.ok and result.diagnostics:
+        for k, v in result.diagnostics.items():
+            print(f"[RESULTS] failure/{k}: {v}")
     total_us = meas.times_us.get("JTOTAL", 0.0)
     if total_us:
         rate = (2 * global_size * args.repeat) / (total_us / 1e6)

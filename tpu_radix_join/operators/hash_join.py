@@ -255,6 +255,23 @@ class HashJoin:
         lost_s, bad_s = win_s.diagnostics(
             ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
             s_ghist, assignment)
+        if cfg.debug_checks:
+            # Per-partition conservation (the strong form of the JOIN_ASSERT
+            # invariants, SURVEY.md §4.2-4.3): the received tuples of every
+            # assigned partition must match its global histogram entry
+            # exactly, not just the totals.  Off by default — an extra
+            # bincount pass per relation over the receive buffers.
+            me = jax.lax.axis_index(ax).astype(jnp.uint32)
+            num_p = r_ghist.shape[0]
+            pp_bad = jnp.bool_(False)
+            for part, ghist, lost in ((rp, r_ghist, lost_r),
+                                      (sp, s_ghist, lost_s)):
+                got_pp = jnp.bincount(
+                    jnp.where(part.valid, part.pid, num_p).astype(jnp.int32),
+                    length=num_p + 1)[:num_p].astype(jnp.uint32)
+                want_pp = jnp.where(assignment == me, ghist, 0)
+                pp_bad = pp_bad | (jnp.any(got_pp != want_pp) & (lost == 0))
+            bad_r = bad_r | pp_bad   # same failure class: misrouting
         net_overflow = lost_r + lost_s                       # already psum'd
         conserve_bad = jax.lax.psum(
             bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)

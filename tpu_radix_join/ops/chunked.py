@@ -46,28 +46,39 @@ def _scan_probe(r_keys: jnp.ndarray, s_keys: jnp.ndarray, num_slabs: int):
 def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
     """Exact match count streaming the outer side in ``slab_size`` slabs.
 
-    ``slab_size`` must divide the outer size (pad the relation with S
-    sentinels otherwise — the generators always produce pow2-friendly sizes).
+    Ragged sizes (streamed chunks, short final chunks) are padded up to a
+    slab multiple with the outer-side sentinel, which matches nothing by the
+    pad-key contract (tuples.py).
     """
-    n = s.key.shape[0]
-    if n % slab_size:
-        raise ValueError(f"outer size {n} not divisible by slab size {slab_size}")
-    per_slab = _scan_probe(r.key, s.key, n // slab_size)
+    from tpu_radix_join.data.tuples import pad_sentinel
+    keys = s.key
+    n = keys.shape[0]
+    pad = (-n) % slab_size
+    if pad:
+        keys = jnp.concatenate(
+            [keys, jnp.full((pad,), pad_sentinel("outer"), keys.dtype)])
+    per_slab = _scan_probe(r.key, keys, (n + pad) // slab_size)
     return int(np.asarray(per_slab).astype(np.uint64).sum())
 
 
 def chunked_join_grid(r_chunks, s_chunks, slab_size: int) -> int:
-    """Both sides streamed: iterables of TupleBatch chunks (host-resident);
-    each inner chunk is joined against every outer chunk exactly once.
+    """Both sides streamed; each inner chunk is joined against every outer
+    chunk exactly once.
 
-    ``s_chunks`` is consumed once per inner chunk, so a one-shot iterator
-    (e.g. ``data/streaming.stream_chunks``) is materialized up front — a
-    silently-exhausted generator would drop every outer chunk after the
-    first inner one."""
-    if not isinstance(s_chunks, (list, tuple)):
-        s_chunks = list(s_chunks)
+    ``s_chunks`` is consumed once per inner chunk, so pass either a
+    re-iterable (list/tuple) or — for outer sides too large to keep resident
+    — a zero-argument factory returning a fresh iterator per inner chunk
+    (e.g. ``lambda: stream_chunks(s_rel, node, c)``), which keeps device
+    memory at O(chunk).  A bare one-shot iterator is materialized up front
+    (resident, but never silently exhausted)."""
+    if callable(s_chunks):
+        s_iter = s_chunks
+    else:
+        if not isinstance(s_chunks, (list, tuple)):
+            s_chunks = list(s_chunks)
+        s_iter = lambda: s_chunks
     total = 0
     for r in r_chunks:
-        for s in s_chunks:
+        for s in s_iter():
             total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]))
     return total
