@@ -19,6 +19,8 @@ probed exactly once, matching the LD kernels' two-level iterCount indexing).
 from __future__ import annotations
 
 import functools
+import json
+import os
 from typing import Tuple
 
 import jax
@@ -61,7 +63,9 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
     return int(np.asarray(per_slab).astype(np.uint64).sum())
 
 
-def chunked_join_grid(r_chunks, s_chunks, slab_size: int) -> int:
+def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
+                      checkpoint_path: str | None = None,
+                      checkpoint_tag: str = "") -> int:
     """Both sides streamed; each inner chunk is joined against every outer
     chunk exactly once.
 
@@ -70,15 +74,68 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int) -> int:
     — a zero-argument factory returning a fresh iterator per inner chunk
     (e.g. ``lambda: stream_chunks(s_rel, node, c)``), which keeps device
     memory at O(chunk).  A bare one-shot iterator is materialized up front
-    (resident, but never silently exhausted)."""
+    (resident, but never silently exhausted).
+
+    ``checkpoint_path`` adds resume support for long grid joins — a
+    capability the single-shot reference lacks entirely (SURVEY.md §5.4):
+    after every (inner, outer) chunk pair the accumulated count and the next
+    pair's (i, j) indices are written atomically (fsync + rename); a rerun
+    with the same arguments skips completed pairs (skipped chunks are
+    regenerated but not probed — generation is cheap, probes are not).  The
+    file is left in place on completion with ``"done": true``.  A
+    fingerprint (slab size + caller-supplied ``checkpoint_tag``) guards
+    against resuming a different join from a stale file — pass a tag that
+    identifies the input relations; mismatches raise instead of silently
+    returning the wrong total, and unreadable files restart from zero.
+    """
     if callable(s_chunks):
         s_iter = s_chunks
     else:
         if not isinstance(s_chunks, (list, tuple)):
             s_chunks = list(s_chunks)
         s_iter = lambda: s_chunks
-    total = 0
-    for r in r_chunks:
-        for s in s_iter():
+
+    fingerprint = {"slab": int(slab_size), "tag": checkpoint_tag}
+    start_i, start_j, total = 0, 0, 0
+    if checkpoint_path and os.path.exists(checkpoint_path):
+        try:
+            with open(checkpoint_path) as f:
+                state = json.load(f)
+            if state["fingerprint"] != fingerprint:
+                raise ValueError(
+                    f"checkpoint {checkpoint_path} belongs to a different "
+                    f"join ({state['fingerprint']} != {fingerprint}); remove "
+                    "it or pass a distinct checkpoint_tag")
+            if state.get("done"):
+                return int(state["total"])
+            start_i, start_j = int(state["i"]), int(state["j"])
+            total = int(state["total"])
+        except (json.JSONDecodeError, KeyError, OSError):
+            # truncated/corrupt checkpoint: restart from zero rather than
+            # wedging every rerun on an unreadable file
+            start_i, start_j, total = 0, 0, 0
+
+    def save(i: int, j: int, total: int, done: bool = False) -> None:
+        if not checkpoint_path:
+            return
+        tmp = f"{checkpoint_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"i": i, "j": j, "total": total, "done": done,
+                       "fingerprint": fingerprint}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, checkpoint_path)
+
+    last_i = start_i
+    for i, r in enumerate(r_chunks):
+        if i < start_i:
+            continue
+        row_start_j = start_j if i == start_i else 0
+        for j, s in enumerate(s_iter()):
+            if j < row_start_j:
+                continue
             total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]))
+            save(i, j + 1, total)
+        last_i = i + 1
+    save(last_i, 0, total, done=True)
     return total
