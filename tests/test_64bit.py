@@ -1,8 +1,9 @@
 """64-bit key support: hi/lo uint32 lanes through the full pipeline.
 
 The 1B CompressedTuple config (BASELINE.md #5) uses int64 keys; on TPU these
-ride as two uint32 lanes with the probe comparing a packed uint64 sort lane
-(requires jax x64)."""
+ride as two uint32 lanes.  The pipeline probes them with a three-key
+lexicographic sort-merge (no device int64, no jax x64); the packed-uint64
+searchsorted ops in ops/build_probe.py remain for x64-enabled hosts."""
 
 import jax
 import jax.numpy as jnp
@@ -85,3 +86,41 @@ def test_compress_roundtrip_is_exact_64(x64):
     got = (np.asarray(back.key_hi, dtype=np.uint64) << np.uint64(32)) | np.asarray(
         back.key, dtype=np.uint64)
     np.testing.assert_array_equal(got, k64)
+
+
+def test_wide_merge_count_no_x64():
+    """The three-key lexicographic path needs no jax x64 — the contract that
+    makes 64-bit keys TPU-native (SURVEY.md §7.4 item 3)."""
+    from tpu_radix_join.ops.merge_count import merge_count_wide_per_partition
+    assert not jax.config.jax_enable_x64
+    rng = np.random.default_rng(3)
+    r64 = rng.integers(0, 1 << 40, 4096, dtype=np.uint64)
+    s64 = np.concatenate([r64[:2048],
+                          rng.integers(0, 1 << 40, 2048, dtype=np.uint64)])
+    rb, sb = _batch64(r64), _batch64(s64)
+    counts = merge_count_wide_per_partition(rb.key, rb.key_hi,
+                                            sb.key, sb.key_hi, 4)
+    assert int(np.asarray(counts).astype(np.uint64).sum()) == _host_count(r64, s64)
+    # per-partition split is by low lo-lane bits
+    got = np.asarray(counts)
+    want = np.zeros(16, np.uint64)
+    rs = np.sort(r64)
+    hi = np.searchsorted(rs, s64, side="right")
+    lo = np.searchsorted(rs, s64, side="left")
+    for k, c in zip(s64, (hi - lo)):
+        want[int(k) & 15] += c
+    np.testing.assert_array_equal(got.astype(np.uint64), want)
+
+
+def test_pipeline_64bit_no_x64():
+    """Full distributed join on 64-bit keys with x64 DISABLED."""
+    assert not jax.config.jax_enable_x64
+    n = 4
+    cfg = JoinConfig(num_nodes=n, network_fanout_bits=4, key_bits=64)
+    rng = np.random.default_rng(11)
+    size = 1 << 12
+    r64 = (rng.permutation(size).astype(np.uint64) | (np.uint64(1) << 40))
+    s64 = (rng.permutation(size).astype(np.uint64) | (np.uint64(1) << 40))
+    res = HashJoin(cfg).join_arrays(_batch64(r64), _batch64(s64))
+    assert res.ok
+    assert res.matches == _host_count(r64, s64) == size

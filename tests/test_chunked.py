@@ -74,25 +74,32 @@ def test_grid_checkpoint_resume(tmp_path):
         import pytest
         with pytest.raises(RuntimeError):
             chunked_join_grid(halves(r), halves(s), 1 << 10,
-                              checkpoint_path=ckpt)
+                              checkpoint_path=ckpt, checkpoint_tag="t")
     finally:
         C.chunked_join_count = orig
     state = json.load(open(ckpt))
     assert not state["done"] and state["total"] > 0
 
     total = chunked_join_grid(halves(r), halves(s), 1 << 10,
-                              checkpoint_path=ckpt)
+                              checkpoint_path=ckpt, checkpoint_tag="t")
     assert total == 1 << 12
     assert json.load(open(ckpt))["done"]
     # a third run short-circuits on the done marker (same fingerprint)
-    assert chunked_join_grid([], lambda: [], 1 << 10,
-                             checkpoint_path=ckpt) == total
-    # a different join geometry must refuse the stale checkpoint
+    assert chunked_join_grid(halves(r), halves(s), 1 << 10,
+                             checkpoint_path=ckpt, checkpoint_tag="t") == total
+    # different geometry, tag, or an untagged call must refuse the file
     import pytest
     with pytest.raises(ValueError):
-        chunked_join_grid(halves(r), halves(s), 1 << 9, checkpoint_path=ckpt)
+        chunked_join_grid(halves(r), halves(s), 1 << 9,
+                          checkpoint_path=ckpt, checkpoint_tag="t")
+    with pytest.raises(ValueError):
+        chunked_join_grid(halves(r), halves(s), 1 << 10,
+                          checkpoint_path=ckpt, checkpoint_tag="other-data")
+    with pytest.raises(ValueError):
+        chunked_join_grid(halves(r), halves(s), 1 << 10,
+                          checkpoint_path=ckpt)
     # corrupt checkpoint: restart from zero, exact result
     with open(ckpt, "w") as f:
         f.write("{trunca")
     assert chunked_join_grid(halves(r), halves(s), 1 << 10,
-                             checkpoint_path=ckpt) == total
+                             checkpoint_path=ckpt, checkpoint_tag="t") == total

@@ -40,10 +40,13 @@ from tpu_radix_join.histograms import (
 from tpu_radix_join.ops.build_probe import (
     probe_count_bucketized,
     probe_count_chunked,
-    probe_count_per_partition,
     probe_materialize,
 )
-from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY, merge_count_per_partition
+from tpu_radix_join.ops.merge_count import (
+    MAX_MERGE_KEY,
+    merge_count_per_partition,
+    merge_count_wide_per_partition,
+)
 from tpu_radix_join.operators.local_partitioning import local_partition
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import network_partition
@@ -204,10 +207,12 @@ class HashJoin:
                     sp.pid, num_p, cfg.chunk_size)
                 local_overflow = jnp.uint32(0)
             elif r.key_hi is not None:
-                # 64-bit keys: searchsorted discipline (uint64 lane, needs x64)
-                counts = probe_count_per_partition(
-                    _as_compressed(rp.batch), _as_compressed(sp.batch),
-                    sp.pid, num_p)
+                # 64-bit keys: three-key lexicographic sort-merge on the
+                # hi/lo uint32 lanes — no device int64, no x64 requirement
+                # (SURVEY.md §7.4 item 3)
+                counts = merge_count_wide_per_partition(
+                    rp.batch.key, rp.batch.key_hi,
+                    sp.batch.key, sp.batch.key_hi, fanout)
                 local_overflow = jnp.uint32(0)
             else:
                 counts = merge_count_per_partition(

@@ -95,7 +95,16 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
             s_chunks = list(s_chunks)
         s_iter = lambda: s_chunks
 
-    fingerprint = {"slab": int(slab_size), "tag": checkpoint_tag}
+    if checkpoint_path and not checkpoint_tag:
+        raise ValueError(
+            "checkpoint_path requires a checkpoint_tag identifying the input "
+            "relations — an untagged checkpoint resumed against different "
+            "data would silently return a wrong total")
+    fingerprint = {"slab": int(slab_size), "tag": checkpoint_tag,
+                   "rows": len(r_chunks) if isinstance(r_chunks, (list, tuple))
+                   else None,
+                   "cols": len(s_chunks) if isinstance(s_chunks, (list, tuple))
+                   else None}
     start_i, start_j, total = 0, 0, 0
     if checkpoint_path and os.path.exists(checkpoint_path):
         try:

@@ -51,21 +51,28 @@ def _pack(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([pr, ps])
 
 
+def _run_weights(is_s: jnp.ndarray, run_start: jnp.ndarray) -> jnp.ndarray:
+    """Per-position match weights for a sorted sequence: at every S position,
+    the number of R tuples in its equal-key run (the module docstring's
+    cumsum/cummax scheme).  ``is_s``: uint32 0/1 side tags in sort order
+    (R before S within a run); ``run_start``: bool, True where a new
+    equal-key run begins."""
+    is_r = jnp.uint32(1) - is_s
+    c_r = jnp.cumsum(is_r, dtype=jnp.uint32)
+    # c_r *before* the run start, propagated across the run via cummax
+    # (c_r is monotone non-decreasing, so cummax of the starts is exact).
+    base_at_start = jnp.where(run_start, c_r - is_r, jnp.uint32(0))
+    base_run = jax.lax.cummax(base_at_start)
+    return is_s * (c_r - base_run)
+
+
 def _weights(packed_sorted: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """(weight per position, key per position) for the sorted packed array."""
     one = jnp.uint32(1)
     key = packed_sorted >> one
     is_s = (packed_sorted & one).astype(jnp.uint32)
-    is_r = one - is_s
-    c_r = jnp.cumsum(is_r, dtype=jnp.uint32)
     prev_key = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), key[:-1]])
-    run_start = key != prev_key
-    # c_r *before* the run start, propagated across the run via cummax
-    # (c_r is monotone non-decreasing, so cummax of the starts is exact).
-    base_at_start = jnp.where(run_start, c_r - is_r, jnp.uint32(0))
-    base_run = jax.lax.cummax(base_at_start)
-    weight = is_s * (c_r - base_run)
-    return weight, key
+    return _run_weights(is_s, key != prev_key), key
 
 
 def merge_count_chunks(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
@@ -112,3 +119,39 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     weight, key = _weights(packed)
     pid = (key & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
     return jnp.bincount(pid, weights=weight, length=1 << fanout_bits).astype(jnp.uint32)
+
+
+def merge_count_wide_per_partition(
+    r_lo: jnp.ndarray, r_hi: jnp.ndarray,
+    s_lo: jnp.ndarray, s_hi: jnp.ndarray,
+    fanout_bits: int,
+) -> jnp.ndarray:
+    """64-bit-key match counting without 64-bit arithmetic.
+
+    TPU int64 is limited/slow (SURVEY.md §7.4 item 3), so wide keys ride as
+    two uint32 lanes and the combined sort is a three-key lexicographic
+    ``lax.sort((hi, lo, tag))`` — the tag key keeps every equal-key run's R
+    tuples ahead of its S tuples, exactly what the 31-bit packing achieves in
+    the single-lane path.  The weight scheme is the module's usual
+    cumsum/cummax pass with run boundaries on (hi, lo).  No jax x64 needed.
+
+    Pad sentinels sit in BOTH lanes (make_padding wide=True), and R/S pads
+    differ in the hi lane, so padding contributes zero weight.
+    """
+    one = jnp.uint32(1)
+    hi = jnp.concatenate([r_hi, s_hi])
+    lo = jnp.concatenate([r_lo, s_lo])
+    tag = jnp.concatenate([
+        jnp.zeros(r_lo.shape, jnp.uint32), jnp.ones(s_lo.shape, jnp.uint32)])
+    hi, lo, tag = jax.lax.sort((hi, lo, tag), num_keys=3, is_stable=False)
+
+    prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), hi[:-1]])
+    prev_lo = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), lo[:-1]])
+    # position 0 is always a run start: (prev_hi, prev_lo) = the S pad pair,
+    # which real keys can't equal (hi < 0xFFFFFFFE contract) — and if x[0] IS
+    # an S pad, its weight is 0 anyway (no R pad shares the run).
+    run_start = (hi != prev_hi) | (lo != prev_lo)
+    weight = _run_weights(tag, run_start)
+    pid = (lo & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
+    return jnp.bincount(pid, weights=weight,
+                        length=1 << fanout_bits).astype(jnp.uint32)
