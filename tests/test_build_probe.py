@@ -74,3 +74,36 @@ def test_probe_materialize_overflow_flag():
     m = probe_materialize(r, s, cap=4)
     assert int(m.overflow) == 1
     assert int(np.asarray(m.valid).sum()) == 4
+
+
+def test_bucketized_merge_equals_dense():
+    from tpu_radix_join.ops.build_probe import (
+        probe_count_bucketized_merge,
+    )
+    from tpu_radix_join.data.tuples import R_PAD_KEY, S_PAD_KEY
+    rng = np.random.default_rng(5)
+    nb, bi, bo = 16, 40, 56
+    inner = rng.integers(0, 64, (nb, bi), dtype=np.uint32)
+    outer = rng.integers(0, 64, (nb, bo), dtype=np.uint32)
+    # sentinel-pad ragged tails like local_partition does
+    for row in range(nb):
+        inner[row, rng.integers(0, bi):] = R_PAD_KEY
+        outer[row, rng.integers(0, bo):] = S_PAD_KEY
+    dense = (inner[:, :, None] == outer[:, None, :]).sum((1, 2))
+    got = np.asarray(probe_count_bucketized_merge(
+        jnp.asarray(inner), jnp.asarray(outer)))
+    np.testing.assert_array_equal(got, dense.astype(np.uint32))
+
+
+def test_two_level_join_large_buckets():
+    """Buckets above DENSE_BUCKET_LIMIT route to the batched sort-merge; the
+    two-level pipeline must stay exact."""
+    from tpu_radix_join import HashJoin, JoinConfig, Relation
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=2, local_fanout_bits=2,
+                     two_level=True, allocation_factor=2.0)
+    size = 1 << 14    # /4 nodes /4 net /4 local => ~256+ slot buckets
+    r = Relation(size, 4, "unique", seed=1)
+    s = Relation(size, 4, "unique", seed=9)
+    res = HashJoin(cfg).join(r, s)
+    assert res.ok
+    assert res.matches == size

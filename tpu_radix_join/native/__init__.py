@@ -1,0 +1,1 @@
+"""Native host runtime: C++ sources compiled on demand (see build.py)."""

@@ -37,7 +37,11 @@ import jax
 import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import CompressedBatch, pad_sentinel
-from tpu_radix_join.ops.sorting import sort_kv_unstable, sort_unstable
+from tpu_radix_join.ops.sorting import (
+    sort_kv_unstable,
+    sort_lex_unstable,
+    sort_unstable,
+)
 
 
 def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
@@ -142,18 +146,55 @@ def probe_count_chunked(
     return jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
 
 
+# Above this per-bucket slot count, the O(bi*bo) dense compare loses to the
+# batched sort-merge (the dense form is the reference's shared-memory probe
+# trade, profitable only for buckets that fit "shared memory"-sized tiles).
+DENSE_BUCKET_LIMIT = 256
+
+
 def probe_count_bucketized(
     inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray
 ) -> jnp.ndarray:
-    """Dense per-bucket compare: inner_blocks [nb, bi], outer_blocks [nb, bo]
-    single-lane keys (sentinel-padded).  Returns per-bucket match counts,
-    uint32 [nb].
+    """Per-bucket match counts, uint32 [nb], for sentinel-padded key blocks
+    inner_blocks [nb, bi] / outer_blocks [nb, bo].
 
-    O(bi*bo) per bucket — the trade the GPU shared-memory probe makes
-    (kernels.cu:199-246); profitable when radix fanout keeps buckets tiny.
+    Auto-selects the discipline: the O(bi*bo) dense equality reduction (the
+    GPU shared-memory probe analog, kernels.cu:199-246) for tiny buckets,
+    else the batched per-bucket sort-merge — O(b log b) rows under one
+    batched ``lax.sort``, which keeps the two-level path feasible when
+    capacity-padded buckets are large.
     """
-    eq = inner_blocks[:, :, None] == outer_blocks[:, None, :]
-    return jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
+    if max(inner_blocks.shape[1], outer_blocks.shape[1]) <= DENSE_BUCKET_LIMIT:
+        eq = inner_blocks[:, :, None] == outer_blocks[:, None, :]
+        return jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
+    return probe_count_bucketized_merge(inner_blocks, outer_blocks)
+
+
+def probe_count_bucketized_merge(
+    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray
+) -> jnp.ndarray:
+    """Batched per-bucket sort-merge counting (same contract as
+    :func:`probe_count_bucketized`).
+
+    Each bucket row is sorted lexicographically by (key, side-tag) in one
+    batched two-key ``lax.sort`` over axis 1 — full 32-bit keys, no packing
+    limit — then the merge-count weight scan (cumsum/cummax of
+    ops/merge_count) runs along the rows.  R/S pad sentinels differ
+    (tuples.py), so padding forms its own runs and contributes zero.
+    """
+    from tpu_radix_join.ops.merge_count import _run_weights
+    nb = inner_blocks.shape[0]
+    keys = jnp.concatenate([inner_blocks, outer_blocks], axis=1)
+    tag = jnp.concatenate([
+        jnp.zeros(inner_blocks.shape, jnp.uint32),
+        jnp.ones(outer_blocks.shape, jnp.uint32)], axis=1)
+    keys, tag = sort_lex_unstable(keys, tag, num_keys=2, dimension=1)
+    prev = jnp.concatenate(
+        [jnp.full((nb, 1), 0xFFFFFFFF, jnp.uint32), keys[:, :-1]], axis=1)
+    # vmap the 1-D weight scan over bucket rows (cumsum/cummax are along the
+    # row, independent per bucket)
+    weights = jax.vmap(_run_weights)(tag, keys != prev)
+    return jnp.sum(weights, axis=1, dtype=jnp.uint32)
 
 
 class MaterializedMatches(NamedTuple):

@@ -39,7 +39,10 @@ _S_PACK_PAD = 0xFFFFFFFF   # key slot 0x7FFFFFFF, tag 1
 
 # The packed value carries the side tag, so equal values are fully
 # interchangeable and an unstable sort loses nothing (ops/sorting.py).
-from tpu_radix_join.ops.sorting import sort_unstable as _sort_unstable
+from tpu_radix_join.ops.sorting import (
+    sort_lex_unstable as _sort_lex_unstable,
+    sort_unstable as _sort_unstable,
+)
 
 
 def _pack(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
@@ -143,7 +146,7 @@ def merge_count_wide_per_partition(
     lo = jnp.concatenate([r_lo, s_lo])
     tag = jnp.concatenate([
         jnp.zeros(r_lo.shape, jnp.uint32), jnp.ones(s_lo.shape, jnp.uint32)])
-    hi, lo, tag = jax.lax.sort((hi, lo, tag), num_keys=3, is_stable=False)
+    hi, lo, tag = _sort_lex_unstable(hi, lo, tag, num_keys=3)
 
     prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), hi[:-1]])
     prev_lo = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), lo[:-1]])

@@ -23,3 +23,11 @@ def sort_unstable(x: jnp.ndarray, dimension: int = -1) -> jnp.ndarray:
 def sort_kv_unstable(key: jnp.ndarray, *values: jnp.ndarray):
     """Unstable key-value sort; returns (sorted key, *values in key order)."""
     return jax.lax.sort((key, *values), num_keys=1, is_stable=False)
+
+
+def sort_lex_unstable(*operands: jnp.ndarray, num_keys: int,
+                      dimension: int = -1):
+    """Unstable lexicographic sort on the first ``num_keys`` operands
+    (remaining operands ride along as values)."""
+    return jax.lax.sort(operands, num_keys=num_keys, dimension=dimension,
+                        is_stable=False)
