@@ -39,6 +39,35 @@ def test_pallas_matches_xla_path():
     assert a == b
 
 
+@pytest.mark.parametrize("fanout", [0, 2, 5])
+def test_partition_kernel_matches_xla(fanout):
+    from tpu_radix_join.ops.merge_count import merge_count_per_partition
+    rng = np.random.default_rng(fanout)
+    r = rng.integers(0, 3000, 2 * TILE + 17).astype(np.uint32)
+    s = rng.integers(0, 3000, TILE - 5).astype(np.uint32)
+    r[:3] = 0xFFFFFFF0      # out-of-range: routed to pad slots, zero weight
+    a = merge_count_per_partition(jnp.asarray(r), jnp.asarray(s), fanout,
+                                  impl="xla")
+    b = merge_count_per_partition(jnp.asarray(r), jnp.asarray(s), fanout,
+                                  impl="pallas_interpret")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_partition_kernel_hot_partition_run_across_tiles():
+    # one key dominating S: its partition's count crosses many tile
+    # boundaries and exercises the carried scan + pl.when accumulation
+    from tpu_radix_join.ops.merge_count import merge_count_per_partition
+    key = np.uint32(7 * 32 + 3)        # partition 3 under fanout 5
+    r = np.concatenate([np.full(50, key, np.uint32),
+                        np.arange(0, TILE, dtype=np.uint32) * 32])  # pid 0
+    s = np.full(3 * TILE, key, np.uint32)
+    counts = merge_count_per_partition(jnp.asarray(r), jnp.asarray(s), 5,
+                                       impl="pallas_interpret")
+    counts = np.asarray(counts)
+    assert counts[3] == 50 * 3 * TILE
+    assert counts.sum() == counts[3]
+
+
 def test_pallas_run_spanning_many_tiles():
     # a single key whose R-run occupies >1 full tile: the carried base/run
     # state must survive multiple tile boundaries

@@ -29,6 +29,24 @@ def test_histogram_with_valid_mask():
         np.asarray(local_histogram(pid, 4, valid)), [1, 1, 1, 0])
 
 
+def test_histogram_pallas_matches_xla():
+    # interpret-mode parity for the TPU streaming-histogram kernel, the
+    # production local_histogram path on real hardware
+    rng = np.random.default_rng(3)
+    pid = jnp.asarray(rng.integers(0, 32, 70000).astype(np.uint32))
+    valid = jnp.asarray(rng.integers(0, 2, 70000).astype(bool))
+    for v in (None, valid):
+        a = np.asarray(local_histogram(pid, 32, v, impl="xla"))
+        b = np.asarray(local_histogram(pid, 32, v, impl="pallas_interpret"))
+        np.testing.assert_array_equal(a, b)
+
+
+def test_histogram_pallas_ignores_out_of_range_ids():
+    pid = jnp.asarray([0, 5, 2, 2, 9], jnp.uint32)   # 5, 9 out of range for 4
+    got = np.asarray(local_histogram(pid, 4, impl="pallas_interpret"))
+    np.testing.assert_array_equal(got, [1, 0, 2, 0])
+
+
 def test_reorder_groups_partitions():
     rng = np.random.default_rng(1)
     keys = rng.integers(0, 1 << 16, 2000).astype(np.uint32)

@@ -137,11 +137,26 @@ class HashJoin:
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)))
 
-    def _measure_capacities(self, r: TupleBatch, s: TupleBatch):
+    def _single_node_sort_probe(self) -> bool:
+        """True when the pipeline takes the n==1 specialization (no shuffle,
+        no windows): the sizing pre-pass would compute capacities nothing
+        reads, so the driver skips it and uses a fixed dummy capacity."""
+        cfg = self.config
+        return (cfg.num_nodes == 1 and not cfg.two_level
+                and cfg.probe_algorithm != "bucket" and not cfg.chunk_size)
+
+    def _measure_capacities(self, r: TupleBatch, s: TupleBatch,
+                            shuffles: bool = True):
         """Window allocation (HashJoin.cpp phase 2): static block capacity =
         next power of two >= worst (sender, dest) demand, or the
-        allocation-factor estimate in "static" mode (no sizing pre-pass)."""
+        allocation-factor estimate in "static" mode (no sizing pre-pass).
+
+        ``shuffles=False`` marks a pipeline variant that takes the n==1
+        no-shuffle specialization: capacities are never read, so skip the
+        sizing program and return a fixed dummy."""
         n = self.config.num_nodes
+        if not shuffles:
+            return 8, 8
         if self.config.window_sizing == "static":
             return (self.config.shuffle_block_capacity(r.size // n),
                     self.config.shuffle_block_capacity(s.size // n))
@@ -170,12 +185,32 @@ class HashJoin:
             # (tuples.py) — and below the 31-bit merge-count packing limit
             # when the merge probe is the branch in use.  Violations flip `ok`
             # rather than silently overcounting against padding slots.
-            uses_merge = (r.key_hi is None and not cfg.two_level
+            sort_probe = (not cfg.two_level
                           and cfg.probe_algorithm != "bucket"
                           and not cfg.chunk_size)
+            uses_merge = r.key_hi is None and sort_probe
             key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
             keys_ok = (jnp.max(_sentinel_lane(r)) < key_cap) & (
                 jnp.max(_sentinel_lane(s)) < key_cap)
+
+            if n == 1 and sort_probe:
+                # Single-node specialization: the all_to_all is an identity
+                # and the sort-merge probe needs no pre-partitioned input
+                # (the reference runs NetworkPartitioning even at 1 node,
+                # HashJoin.cpp:98-105, because its pointer-chasing BuildProbe
+                # requires partitioned buffers — the merge probe does not),
+                # so phases 2-5 vanish and JPROC is the probe alone.
+                if r.key_hi is not None:
+                    counts = merge_count_wide_per_partition(
+                        r.key, r.key_hi, s.key, s.key_hi, fanout)
+                else:
+                    counts = merge_count_per_partition(r.key, s.key, fanout)
+                zero = jnp.uint32(0)
+                flags = jnp.stack([
+                    jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
+                    zero, zero, zero,
+                ])
+                return counts, flags
 
             # ---- Phases 1-4: histograms, window allocation (implicit in
             # static shapes), all_to_all shuffle, conservation barrier
@@ -369,7 +404,8 @@ class HashJoin:
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
-        cap_r, cap_s = self._measure_capacities(r, s)
+        cap_r, cap_s = self._measure_capacities(
+            r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
         local_slack = 1

@@ -111,17 +111,80 @@ def merge_count_pallas(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     return merge_scan_chunks(_sort_unstable(packed), interpret=interpret)
 
 
+def _pack_pm(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
+             fanout_bits: int) -> jnp.ndarray:
+    """Partition-major packing: ``pid | key_remainder | side_tag`` from top to
+    bottom bits, so a single sort groups tuples by network partition first and
+    by full key within it (equal full keys stay adjacent: same pid + same
+    remainder).  This is what lets the fused Pallas kernel accumulate
+    per-partition counts with ~2 active reductions per tile
+    (merge_scan._kernel_partitions).
+
+    Pad handling mirrors ``_pack``: out-of-range keys map to the reserved key
+    slots 0x7FFFFFFE (R) / 0x7FFFFFFF (S), which land at the TOP of the
+    remainder range of partitions (P-2) and (P-1) — interior to the array,
+    not at its end, but in runs no cross-side real key can share (real keys
+    <= MAX_MERGE_KEY exclude exactly those two (pid, remainder) pairs), so
+    they carry zero weight wherever they sort."""
+    one = jnp.uint32(1)
+    f = jnp.uint32(fanout_bits)
+    mask = jnp.uint32((1 << fanout_bits) - 1)
+
+    def pm(keys, ok, pad_key, tag):
+        k = jnp.where(ok, keys, jnp.uint32(pad_key))
+        pid = k & mask
+        rem = k >> f
+        if fanout_bits:
+            top = pid << jnp.uint32(32 - fanout_bits)
+        else:
+            top = jnp.uint32(0)
+        return top | (rem << one) | jnp.uint32(tag)
+
+    r_ok = r_keys <= jnp.uint32(MAX_MERGE_KEY)
+    s_ok = s_keys <= jnp.uint32(MAX_MERGE_KEY)
+    return jnp.concatenate([
+        pm(r_keys, r_ok, 0x7FFFFFFE, 0),
+        pm(s_keys, s_ok, 0x7FFFFFFF, 1),
+    ])
+
+
 def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
-                              fanout_bits: int) -> jnp.ndarray:
+                              fanout_bits: int,
+                              impl: str | None = None) -> jnp.ndarray:
     """Per-network-partition match counts, uint32 [1 << fanout_bits].
 
-    One extra scatter-add pass (bincount) over the sort order; partitions are
-    the low key bits so they interleave in sorted order.  Each partition's
-    count must stay < 2**32 (SURVEY.md §7.4 item 2 contract)."""
-    packed = _sort_unstable(_pack(r_keys, s_keys))
-    weight, key = _weights(packed)
-    pid = (key & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
-    return jnp.bincount(pid, weights=weight, length=1 << fanout_bits).astype(jnp.uint32)
+    Each partition's count must stay < 2**32 (SURVEY.md §7.4 item 2
+    contract).  ``impl``: None = auto (fused Pallas kernel on TPU, XLA
+    elsewhere), or one of "xla", "pallas", "pallas_interpret".
+
+    The Pallas path sorts in partition-major packing and fuses the weight
+    scan + per-partition accumulation into one pass
+    (merge_scan.merge_scan_partitions); the XLA path is the portable
+    fallback: low-bit packing + a weights bincount (a scatter-add XLA
+    serializes on TPU — measured 375.7 ms vs ~55 ms total for the Pallas
+    path at 16M⋈16M, round 2).
+    """
+    if impl is None:
+        from tpu_radix_join.ops.pallas.merge_scan import pallas_available
+        impl = "pallas" if (pallas_available()
+                            and (1 << fanout_bits) <= 128) else "xla"
+    if impl == "xla":
+        packed = _sort_unstable(_pack(r_keys, s_keys))
+        weight, key = _weights(packed)
+        pid = (key & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
+        return jnp.bincount(pid, weights=weight,
+                            length=1 << fanout_bits).astype(jnp.uint32)
+    from tpu_radix_join.ops.pallas.merge_scan import TILE, merge_scan_partitions
+    packed = _sort_unstable(_pack_pm(r_keys, s_keys, fanout_bits))
+    pad = (-packed.shape[0]) % TILE
+    if pad:
+        # post-sort padding: 0xFFFFFFFF is the partition-major S pad (all-ones
+        # pid and remainder), >= every packed value, so sortedness holds
+        packed = jnp.concatenate(
+            [packed, jnp.full((pad,), _S_PACK_PAD, jnp.uint32)])
+    return merge_scan_partitions(
+        packed, num_partitions=1 << fanout_bits,
+        interpret=(impl == "pallas_interpret"))
 
 
 def merge_count_wide_per_partition(

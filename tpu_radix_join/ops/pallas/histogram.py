@@ -23,6 +23,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpu_radix_join.ops.pallas.merge_scan import out_struct
+
 ROWS = 2048          # tile = ROWS x 128 uint32 = 1MB VMEM
 LANES = 128
 MAX_PARTITIONS = 128  # unrolled per-partition reductions; keep the loop sane
@@ -91,7 +93,7 @@ def histogram_pallas(pid: jnp.ndarray,
                                memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec((num_partitions,), lambda t: (0,),
                                memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((num_partitions,), jnp.int32),
+        out_shape=out_struct((num_partitions,), jnp.int32, pid),
         interpret=interpret,
     )(pid.reshape(num_tiles * ROWS, LANES),
       w.astype(jnp.uint32).reshape(num_tiles * ROWS, LANES)

@@ -42,6 +42,17 @@ def pallas_available() -> bool:
         return False
 
 
+def out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct whose varying-manual-axes (vma) annotation is
+    inherited from ``like``: inside a ``shard_map`` with check_vma=True,
+    pallas_call outputs must declare how they vary over the mesh axes — a
+    per-device kernel output varies exactly like its per-device input."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _iscan(x: jnp.ndarray, op, ident, axis: int) -> jnp.ndarray:
     """Inclusive Hillis-Steele scan along ``axis`` built from circular roll +
     iota mask (Mosaic lowers neither the cumsum/cummax primitives nor
@@ -75,26 +86,19 @@ def _tile_cummax(x: jnp.ndarray) -> jnp.ndarray:
     return jnp.maximum(lane, prev)
 
 
-def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
-    """All arithmetic is int32: Mosaic does not legalize unsigned max or
-    reductions, and every quantity here fits — keys are packed>>1 < 2^31,
-    counts <= n < 2^31.  The prev-key sentinel is -1 (no valid key < 0)."""
-    t = pl.program_id(0)
+def _tile_scan(packed, carry_c_r, carry_base, carry_prev):
+    """Shared per-tile merge-weight scan.  All arithmetic is int32: Mosaic
+    does not legalize unsigned max or reductions, and every quantity here
+    fits — keys are packed>>1 < 2^31, counts <= n < 2^31.  The prev-key
+    sentinel is -1 (no valid key < 0).
 
-    @pl.when(t == 0)
-    def _init():
-        c_r_ref[0] = jnp.int32(0)
-        base_ref[0] = jnp.int32(0)
-        prev_key_ref[0] = jnp.int32(-1)   # never equals a real key
-
-    packed = packed_ref[:]                      # [ROWS, 128] uint32
+    Returns (weight, key, new_c_r, new_base, new_prev_key); the carries'
+    "last flat element" is expressed as a reduction (Mosaic cannot extract a
+    VMEM scalar): c_r and base_run are nondecreasing in flat order and keys
+    are sorted, so last == max (or carry + tile sum)."""
     key = (packed >> jnp.uint32(1)).astype(jnp.int32)
     is_s = (packed & jnp.uint32(1)).astype(jnp.int32)
     is_r = 1 - is_s
-
-    carry_c_r = c_r_ref[0]
-    carry_base = base_ref[0]
-    carry_prev = prev_key_ref[0]
 
     c_r = _tile_cumsum(is_r) + carry_c_r
 
@@ -113,14 +117,108 @@ def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
     base_run = jnp.maximum(_tile_cummax(base_at_start), carry_base)
 
     weight = is_s * (c_r - base_run)
-    out_ref[t, 0] = jnp.sum(weight).astype(jnp.uint32)
+    return (weight, key, carry_c_r + jnp.sum(is_r), jnp.max(base_run),
+            jnp.max(key))
 
-    # last flat element of each carry, expressed as a reduction (Mosaic
-    # cannot extract a VMEM scalar): c_r and base_run are nondecreasing in
-    # flat order and keys are sorted, so last == max (or carry + tile sum).
-    c_r_ref[0] = carry_c_r + jnp.sum(is_r)
-    base_ref[0] = jnp.max(base_run)
-    prev_key_ref[0] = jnp.max(key)
+
+def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        c_r_ref[0] = jnp.int32(0)
+        base_ref[0] = jnp.int32(0)
+        prev_key_ref[0] = jnp.int32(-1)   # never equals a real key
+
+    weight, _, c_r, base, prev = _tile_scan(
+        packed_ref[:], c_r_ref[0], base_ref[0], prev_key_ref[0])
+    out_ref[t, 0] = jnp.sum(weight).astype(jnp.uint32)
+    c_r_ref[0] = c_r
+    base_ref[0] = base
+    prev_key_ref[0] = prev
+
+
+def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
+                       *, num_partitions: int, pid_shift: int):
+    """Merge-weight scan fused with per-partition accumulation.
+
+    Input is sorted in PARTITION-MAJOR packing (pid in the top bits, see
+    merge_count._pack_pm), so each tile intersects only a narrow contiguous
+    pid range; the per-partition masked reductions are ``pl.when``-guarded on
+    that range, so only ~2 of them execute per tile regardless of the fanout.
+    Accumulation is int32 (wraps identically to the uint32 contract); the
+    caller bitcasts.
+    """
+    t = pl.program_id(0)
+
+    @pl.when(t == 0)
+    def _init():
+        for p in range(num_partitions):
+            out_ref[p] = jnp.int32(0)
+        c_r_ref[0] = jnp.int32(0)
+        base_ref[0] = jnp.int32(0)
+        prev_key_ref[0] = jnp.int32(-1)
+
+    packed = packed_ref[:]
+    weight, _, c_r, base, prev = _tile_scan(
+        packed, c_r_ref[0], base_ref[0], prev_key_ref[0])
+    if num_partitions == 1:
+        out_ref[0] = out_ref[0] + jnp.sum(jnp.sum(weight, axis=0))
+    else:
+        pid = (packed >> jnp.uint32(pid_shift)).astype(jnp.int32)
+        pid_min = jnp.min(pid)
+        pid_max = jnp.max(pid)
+        for p in range(num_partitions):
+            @pl.when((pid_min <= p) & (p <= pid_max))
+            def _acc(p=p):
+                c = jnp.sum(jnp.sum(jnp.where(pid == p, weight, 0), axis=0))
+                out_ref[p] = out_ref[p] + c
+
+    c_r_ref[0] = c_r
+    base_ref[0] = base
+    prev_key_ref[0] = prev
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "interpret"))
+def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
+                          interpret: bool = False) -> jnp.ndarray:
+    """Per-partition match counts (uint32 [num_partitions]) in ONE pass over
+    a partition-major sorted packed array (merge_count._pack_pm layout:
+    pid in the top log2(num_partitions) bits, then key remainder, then the
+    side tag in bit 0).
+
+    Replaces sort + ~5 XLA scan passes + a 33.5M-weight ``jnp.bincount``
+    scatter-add (measured 375.7 ms at 16M⋈16M on the round-2 chip; this
+    kernel's whole post-sort phase is ~one HBM pass).  Length must be a tile
+    multiple (pad post-sort with 0xFFFFFFFF = the S pad, which sorts last and
+    carries zero weight).
+    """
+    n = packed_sorted.shape[0]
+    if n % TILE:
+        raise ValueError(f"length {n} must be a multiple of {TILE}")
+    if num_partitions & (num_partitions - 1):
+        raise ValueError("num_partitions must be a power of two")
+    num_tiles = n // TILE
+    pid_shift = 32 - (num_partitions.bit_length() - 1)
+    kernel = functools.partial(_kernel_partitions,
+                               num_partitions=num_partitions,
+                               pid_shift=pid_shift)
+    out = pl.pallas_call(
+        kernel,
+        grid=(num_tiles,),
+        in_specs=[pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((num_partitions,), lambda t: (0,),
+                               memory_space=pltpu.SMEM),
+        out_shape=out_struct((num_partitions,), jnp.int32, packed_sorted),
+        scratch_shapes=[
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+            pltpu.SMEM((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(packed_sorted.reshape(num_tiles * ROWS, LANES))
+    return jax.lax.bitcast_convert_type(out, jnp.uint32)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -145,7 +243,7 @@ def merge_scan_chunks(packed_sorted: jnp.ndarray,
         # every grid step maps the same block and writes its own row.
         out_specs=pl.BlockSpec((num_tiles, 1), lambda t: (0, 0),
                                memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((num_tiles, 1), jnp.uint32),
+        out_shape=out_struct((num_tiles, 1), jnp.uint32, packed_sorted),
         scratch_shapes=[
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SMEM((1,), jnp.int32),

@@ -24,16 +24,31 @@ from tpu_radix_join.ops.sorting import sort_kv_unstable
 
 
 def local_histogram(pid: jnp.ndarray, num_partitions: int,
-                    valid: jnp.ndarray | None = None) -> jnp.ndarray:
+                    valid: jnp.ndarray | None = None,
+                    impl: str | None = None) -> jnp.ndarray:
     """Count tuples per partition (LocalHistogram.cpp:44-47).
 
     ``pid`` uint32 [n]; returns uint32 [num_partitions].  ``valid`` masks out
     padding slots (the reference never needs this because MPI buffers are
     exactly sized; statically-shaped TPU blocks do).
+
+    ``impl``: None = auto — the Pallas streaming histogram on TPU (one HBM
+    pass, masked VPU reductions; 7.5-10 ms at 16M, round-2 chip) vs the XLA
+    ``bincount`` scatter-add elsewhere (XLA serializes it on TPU: 154 ms at
+    16M).  "xla" / "pallas" / "pallas_interpret" force a path.
     """
+    from tpu_radix_join.ops.pallas.histogram import (
+        MAX_PARTITIONS, histogram_pallas, pallas_histogram_available)
+    if impl is None:
+        impl = "pallas" if (pallas_histogram_available()
+                            and num_partitions <= MAX_PARTITIONS) else "xla"
     weights = None if valid is None else valid.astype(jnp.uint32)
-    hist = jnp.bincount(pid.astype(jnp.int32), weights=weights, length=num_partitions)
-    return hist.astype(jnp.uint32)
+    if impl == "xla":
+        hist = jnp.bincount(pid.astype(jnp.int32), weights=weights,
+                            length=num_partitions)
+        return hist.astype(jnp.uint32)
+    return histogram_pallas(pid, weights, num_partitions=num_partitions,
+                            interpret=(impl == "pallas_interpret"))
 
 
 def exclusive_cumsum(hist: jnp.ndarray) -> jnp.ndarray:
