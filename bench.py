@@ -87,8 +87,9 @@ def main():
         rb = eng._place(r_rel)
         sb = eng._place(s_rel)
         jax.block_until_ready((rb, sb))
-        fn = eng._get_compiled(rb, sb, *eng._measure_capacities(
-            rb, sb, shuffles=not eng._single_node_sort_probe()))
+        cap_r, cap_s, _ = eng._measure_capacities(
+            rb, sb, shuffles=not eng._single_node_sort_probe())
+        fn = eng._get_compiled(rb, sb, cap_r, cap_s)
         counts, flags = fn(rb, sb)
         flags = np.asarray(flags)
         pipe_matches = int(np.asarray(counts).astype(np.uint64).sum())

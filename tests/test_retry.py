@@ -19,7 +19,8 @@ def test_overflow_diagnosed():
     r, s = _skewed()
     res = HashJoin(cfg).join(r, s)
     assert not res.ok
-    assert res.diagnostics["shuffle_overflow_tuples"] > 0
+    # the zipf outer side is what concentrates on one destination
+    assert res.diagnostics["shuffle_overflow_s_tuples"] > 0
     assert res.diagnostics["key_contract_violations"] == 0
     assert res.diagnostics["conservation_violations"] == 0
 
@@ -31,6 +32,31 @@ def test_retry_recovers_exact_count():
     res = HashJoin(cfg).join(r, s)
     assert res.ok, res.diagnostics
     assert res.matches == (1 << 13)
+
+
+def test_retry_grows_only_overflowing_window():
+    # Side-separated overflow flags (Window.cpp:168-177 sizes each relation's
+    # window independently): an S-only overflow must leave the R window alone.
+    import jax.numpy as jnp
+    import numpy as np
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.performance import Measurements
+    n, size = 4, 1 << 12
+    cfg = JoinConfig(num_nodes=n, window_sizing="static",
+                     allocation_factor=2.0, max_retries=5)
+    meas = Measurements(0, n)
+    hj = HashJoin(cfg, measurements=meas)
+    r = TupleBatch(key=jnp.arange(size, dtype=jnp.uint32),
+                   rid=jnp.arange(size, dtype=jnp.uint32))
+    # every outer tuple carries ONE key -> one destination block overflows
+    s = TupleBatch(key=jnp.zeros(size, jnp.uint32),
+                   rid=jnp.arange(size, dtype=jnp.uint32))
+    res = hj.join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size       # all of S matches the single key 0 in R
+    cap0 = cfg.shuffle_block_capacity(size // n)
+    assert meas.counters["WINCAPR"] == cap0      # R window never grew
+    assert meas.counters["WINCAPS"] > cap0       # S window did
 
 
 def test_materialize_rate_cap_retry():

@@ -1,0 +1,128 @@
+"""Hot-partition skew splitting (operators/skew.py): the probe-level split
+the reference keeps in its dormant SD::OPT machinery
+(kernels_optimized.cu:301-344,864-943).  Assignment-level balancing cannot
+spread a single dominant partition; these tests pin the split behavior —
+inner replicated, outer sharded, exact counts, per-device balance."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_radix_join import HashJoin, JoinConfig, Relation
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.operators import skew
+
+
+def _batch(keys, hi=None):
+    keys = np.asarray(keys, np.uint32)
+    return TupleBatch(
+        key=jnp.asarray(keys),
+        rid=jnp.arange(keys.shape[0], dtype=jnp.uint32),
+        key_hi=None if hi is None else jnp.asarray(
+            np.broadcast_to(np.uint32(hi), keys.shape)))
+
+
+def test_detection_helpers():
+    r = np.full(32, 100, np.uint64)
+    s = np.full(32, 100, np.uint64)
+    s[3] = 10000
+    hot = skew.detect_hot_partitions(r, s, 4.0)
+    assert hot[3] and hot.sum() == 1
+    bits = skew.hot_mask_bits(hot)
+    assert bits == 1 << 3
+    got = np.asarray(skew.is_hot(jnp.arange(32, dtype=jnp.uint32), bits))
+    np.testing.assert_array_equal(got, hot)
+    np.testing.assert_array_equal(
+        np.asarray(skew.mask_hot(jnp.asarray(s.astype(np.uint32)), bits)),
+        np.where(hot, 0, s).astype(np.uint32))
+
+
+def _hot_workload(size):
+    """R: dense unique keys.  S: half the relation is ONE key (partition 3
+    under fanout 5), half dense unique — every S tuple matches exactly once,
+    so matches == size and partition 3 is catastrophically hot."""
+    half = size // 2
+    rk = np.arange(size, dtype=np.uint32)
+    sk = np.concatenate([np.full(half, 3, np.uint32),
+                         np.arange(half, dtype=np.uint32)])
+    return _batch(rk), _batch(sk)
+
+
+def test_hot_key_split_balances_devices():
+    # VERDICT r1 item 3's acceptance test: one key is 50% of S; the split
+    # must spread its matches across the 8-device mesh with a balance bound,
+    # where the unsplit pipeline piles them on one device.
+    n, size = 8, 1 << 15
+    r, s = _hot_workload(size)
+    cfg = JoinConfig(num_nodes=n, skew_threshold=4.0, max_retries=1)
+    res = HashJoin(cfg).join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+    pc = res.partition_counts.reshape(n, 32)
+    hot = pc[:, 3].astype(np.int64)
+    assert hot.sum() == (size // 2) + (size // 2) // 32
+    # rid round-robin spread: every device probes a near-equal hot shard
+    assert hot.min() > 0
+    assert hot.max() <= 1.5 * hot.mean()
+
+    # contrast: without splitting the whole hot partition sits on one device
+    res0 = HashJoin(cfg.replace(skew_threshold=None)).join_arrays(r, s)
+    assert res0.ok and res0.matches == size
+    pc0 = res0.partition_counts.reshape(n, 32)
+    assert (pc0[:, 3] > 0).sum() == 1
+
+
+def test_hot_split_with_debug_checks():
+    # the strong per-partition conservation form must hold under the split
+    # routing (hot rows excluded from the per-device expectation)
+    n, size = 8, 1 << 14
+    r, s = _hot_workload(size)
+    cfg = JoinConfig(num_nodes=n, skew_threshold=4.0, debug_checks=True)
+    res = HashJoin(cfg).join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+
+
+def test_hot_split_wide_keys():
+    # 64-bit keys ride hi/lo lanes through the same split route
+    n, size = 4, 1 << 13
+    half = size // 2
+    rk = np.arange(size, dtype=np.uint32)
+    sk = np.concatenate([np.full(half, 3, np.uint32),
+                         np.arange(half, dtype=np.uint32)])
+    cfg = JoinConfig(num_nodes=n, key_bits=64, skew_threshold=4.0)
+    res = HashJoin(cfg).join_arrays(_batch(rk, hi=7), _batch(sk, hi=7))
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+    pc = res.partition_counts.reshape(n, 32)
+    assert (pc[:, 3] > 0).all()       # hot work on every device
+
+
+def test_zipf_skew_split_end_to_end():
+    n, size = 8, 1 << 14
+    cfg = JoinConfig(num_nodes=n, skew_threshold=3.0,
+                     assignment_policy="load_aware")
+    hj = HashJoin(cfg)
+    r = hj._place(Relation(size, n, "unique", seed=1))
+    s = hj._place(Relation(size, n, "zipf", zipf_theta=1.1,
+                           key_domain=size, seed=3))
+    _, _, plan = hj._measure_capacities(r, s)
+    assert plan is not None and plan[0] != 0   # detection actually fired
+    res = hj.join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+
+
+def test_config_rejects_unsupported_skew_combos():
+    with pytest.raises(ValueError):
+        JoinConfig(skew_threshold=2.0, two_level=True)
+    with pytest.raises(ValueError):
+        JoinConfig(skew_threshold=2.0, probe_algorithm="bucket")
+    with pytest.raises(ValueError):
+        JoinConfig(skew_threshold=2.0, network_fanout_bits=6)
+    with pytest.raises(ValueError):
+        JoinConfig(skew_threshold=2.0, window_sizing="static")
+    with pytest.raises(NotImplementedError):
+        cfg = JoinConfig(num_nodes=2, skew_threshold=2.0)
+        r = Relation(1 << 10, 2, "unique", seed=1)
+        HashJoin(cfg).join_materialize(r, r)

@@ -73,6 +73,16 @@ class JoinConfig:
                                              # static shapes (0 = detect only, the
                                              # reference's abort-on-failure parity)
 
+    # --- skew handling ---------------------------------------------------------
+    # Probe-level hot-partition splitting (operators/skew.py; the reference's
+    # dormant SD::OPT skew machinery, kernels_optimized.cu:301-344,864-943):
+    # partitions whose global (R+S) weight exceeds skew_threshold x the mean
+    # are split — inner side replicated via all_gather, outer side sharded
+    # round-robin — instead of owned by one node.  None disables.  Requires
+    # the sort probe discipline and network fanout <= 5 (the hot set is a
+    # uint32 bit mask).
+    skew_threshold: Optional[float] = None
+
     # --- instrumentation -------------------------------------------------------
     debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
 
@@ -95,6 +105,21 @@ class JoinConfig:
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.skew_threshold is not None:
+            if self.skew_threshold <= 0:
+                raise ValueError("skew_threshold must be positive")
+            if self.two_level or self.probe_algorithm == "bucket" or self.chunk_size:
+                raise ValueError(
+                    "skew splitting requires the sort probe discipline "
+                    "(two_level/bucket/chunked probes have no split path)")
+            if self.network_fanout_bits > 5:
+                raise ValueError(
+                    "skew splitting supports network fanout <= 5 "
+                    "(hot set is a uint32 bit mask)")
+            if self.window_sizing != "measured":
+                raise ValueError(
+                    "skew splitting requires window_sizing='measured' "
+                    "(hot detection reads the sizing program's histograms)")
         if self.chunk_size is not None and (
                 self.chunk_size < 1
                 or self.two_level or self.probe_algorithm == "bucket"):

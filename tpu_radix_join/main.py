@@ -44,6 +44,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(out-of-core LD mode)")
     p.add_argument("--max-retries", type=int, default=0,
                    help="capacity-shortfall retries with doubled shapes")
+    p.add_argument("--skew-threshold", type=float, default=None,
+                   help="split partitions heavier than this multiple of the "
+                        "mean (replicate inner / spread outer); off by default")
     p.add_argument("--debug-checks", action="store_true",
                    help="per-partition conservation invariants "
                         "(JOIN_ASSERT analog; extra passes)")
@@ -86,6 +89,7 @@ def main(argv=None) -> int:
         window_sizing=args.window_sizing,
         chunk_size=args.chunk_size,
         max_retries=args.max_retries,
+        skew_threshold=args.skew_threshold,
         debug_checks=args.debug_checks,
     )
     global_size = args.tuples_per_node * nodes

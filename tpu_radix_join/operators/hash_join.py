@@ -47,7 +47,9 @@ from tpu_radix_join.ops.merge_count import (
     merge_count_per_partition,
     merge_count_wide_per_partition,
 )
+from tpu_radix_join.operators import skew
 from tpu_radix_join.operators.local_partitioning import local_partition
+from tpu_radix_join.ops.radix import local_histogram, scatter_to_blocks
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import network_partition
 from tpu_radix_join.parallel.window import ExchangeResult, Window
@@ -103,7 +105,7 @@ class HashJoin:
         self.measurements = measurements   # performance.Measurements or None
 
     # ------------------------------------------------------------------ build
-    def _histogram_fn(self):
+    def _histogram_fn(self, hot_bits: int = 0):
         """Phase 1+2 front half: per-(sender, destination) shuffle demand.
 
         The reference sizes each RMA window exactly from the global histogram
@@ -113,6 +115,12 @@ class HashJoin:
         the true send demands; the host rounds the max up to a power of two and
         compiles the shuffle program at that static capacity.  Guarantees the
         conservation invariant regardless of skew (SURVEY.md §7.4 item 1).
+
+        Also returns the global histograms (for host-side hot-partition
+        detection, operators/skew.py) and, when ``hot_bits`` marks a hot set,
+        the per-device hot inner-tuple count (the exact capacity for the
+        replication buffer) with demands adjusted to the split routing:
+        hot R leaves the shuffle, hot S spreads round-robin.
         """
         cfg = self.config
         ax = cfg.mesh_axes
@@ -120,22 +128,41 @@ class HashJoin:
         fanout = cfg.network_fanout_bits
 
         def body(r: TupleBatch, s: TupleBatch):
-            _, r_hist = compute_local_histogram(r, fanout)
-            _, s_hist = compute_local_histogram(s, fanout)
+            r_pid, r_hist = compute_local_histogram(r, fanout)
+            s_pid, s_hist = compute_local_histogram(s, fanout)
             r_ghist = compute_global_histogram(r_hist, ax)
             s_ghist = compute_global_histogram(s_hist, ax)
+            r_hist_eff, s_hist_eff = r_hist, s_hist
+            r_gh_eff, s_gh_eff = r_ghist, s_ghist
+            spread_demand = jnp.zeros((n,), jnp.uint32)
+            hot_r_count = jnp.zeros((1,), jnp.uint32)
+            if hot_bits:
+                r_hist_eff = skew.mask_hot(r_hist, hot_bits)
+                s_hist_eff = skew.mask_hot(s_hist, hot_bits)
+                r_gh_eff = skew.mask_hot(r_ghist, hot_bits)
+                s_gh_eff = skew.mask_hot(s_ghist, hot_bits)
+                is_hot_s = skew.is_hot(s_pid, hot_bits)
+                spread_demand = local_histogram(
+                    skew.spread_destinations(s.rid, n), n, valid=is_hot_s)
+                hot_r_count = jnp.sum(
+                    skew.is_hot(r_pid, hot_bits).astype(jnp.uint32)
+                ).reshape(1)
             assignment = compute_partition_assignment(
-                r_ghist, s_ghist, n, cfg.assignment_policy)
+                r_gh_eff, s_gh_eff, n, cfg.assignment_policy)
             dest_onehot = (
                 assignment[None, :] == jnp.arange(n, dtype=jnp.uint32)[:, None]
             )  # [N_dest, P]
-            r_demand = jnp.sum(jnp.where(dest_onehot, r_hist[None, :], 0), axis=1)
-            s_demand = jnp.sum(jnp.where(dest_onehot, s_hist[None, :], 0), axis=1)
-            return r_demand.astype(jnp.uint32), s_demand.astype(jnp.uint32)
+            r_demand = jnp.sum(jnp.where(dest_onehot, r_hist_eff[None, :], 0),
+                               axis=1)
+            s_demand = jnp.sum(jnp.where(dest_onehot, s_hist_eff[None, :], 0),
+                               axis=1) + spread_demand
+            return (r_demand.astype(jnp.uint32), s_demand.astype(jnp.uint32),
+                    r_ghist, s_ghist, hot_r_count)
 
         spec = P(cfg.mesh_axes)
         return jax.jit(jax.shard_map(
-            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=(spec, spec)))
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=(spec, spec, P(), P(), spec)))
 
     def _single_node_sort_probe(self) -> bool:
         """True when the pipeline takes the n==1 specialization (no shuffle,
@@ -147,31 +174,54 @@ class HashJoin:
 
     def _measure_capacities(self, r: TupleBatch, s: TupleBatch,
                             shuffles: bool = True):
-        """Window allocation (HashJoin.cpp phase 2): static block capacity =
-        next power of two >= worst (sender, dest) demand, or the
-        allocation-factor estimate in "static" mode (no sizing pre-pass).
+        """Window allocation (HashJoin.cpp phase 2): (cap_r, cap_s, skew_plan)
+        — static block capacity = next power of two >= worst (sender, dest)
+        demand, or the allocation-factor estimate in "static" mode (no sizing
+        pre-pass).
+
+        ``skew_plan`` is None, or ``(hot_bits, hot_cap)`` when
+        config.skew_threshold detects hot partitions in the measured global
+        histograms: the pipeline is then compiled with the split routing
+        (operators/skew.py) and a replication buffer of ``hot_cap`` slots
+        (exact worst per-device hot inner count, measured by a second sizing
+        dispatch).
 
         ``shuffles=False`` marks a pipeline variant that takes the n==1
         no-shuffle specialization: capacities are never read, so skip the
-        sizing program and return a fixed dummy."""
-        n = self.config.num_nodes
+        sizing program and return fixed dummies."""
+        cfg = self.config
+        n = cfg.num_nodes
         if not shuffles:
-            return 8, 8
-        if self.config.window_sizing == "static":
-            return (self.config.shuffle_block_capacity(r.size // n),
-                    self.config.shuffle_block_capacity(s.size // n))
-        if "hist" not in self._compiled:
-            self._compiled["hist"] = self._histogram_fn()
-        r_demand, s_demand = self._compiled["hist"](r, s)
+            return 8, 8, None
+        if cfg.window_sizing == "static":
+            return (cfg.shuffle_block_capacity(r.size // n),
+                    cfg.shuffle_block_capacity(s.size // n), None)
+        if ("hist", 0) not in self._compiled:
+            self._compiled[("hist", 0)] = self._histogram_fn()
+        r_demand, s_demand, r_gh, s_gh, _ = self._compiled[("hist", 0)](r, s)
 
         def cap(demand):
             worst = max(1, int(np.asarray(demand).max()))
             return max(8, 1 << (worst - 1).bit_length())
 
-        return cap(r_demand), cap(s_demand)
+        skew_plan = None
+        if cfg.skew_threshold is not None and n > 1:
+            hot = skew.detect_hot_partitions(
+                np.asarray(r_gh), np.asarray(s_gh), cfg.skew_threshold)
+            if hot.any():
+                hot_bits = skew.hot_mask_bits(hot)
+                if ("hist", hot_bits) not in self._compiled:
+                    self._compiled[("hist", hot_bits)] = self._histogram_fn(
+                        hot_bits)
+                r_demand, s_demand, _, _, hot_counts = self._compiled[
+                    ("hist", hot_bits)](r, s)
+                skew_plan = (hot_bits, cap(hot_counts))
+
+        return cap(r_demand), cap(s_demand), skew_plan
 
     def _pipeline_fn(self, local_size_r: int, local_size_s: int,
-                     cap_r: int, cap_s: int, local_slack: int = 1):
+                     cap_r: int, cap_s: int, local_slack: int = 1,
+                     skew_plan=None):
         cfg = self.config
         ax = cfg.mesh_axes
         n = cfg.num_nodes
@@ -208,15 +258,15 @@ class HashJoin:
                 zero = jnp.uint32(0)
                 flags = jnp.stack([
                     jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
-                    zero, zero, zero,
+                    zero, zero, zero, zero, zero,
                 ])
                 return counts, flags
 
             # ---- Phases 1-4: histograms, window allocation (implicit in
             # static shapes), all_to_all shuffle, conservation barrier
             # (HashJoin.cpp:58-121) — shared with the materialize variant ----
-            rp, sp, net_overflow, conserve_bad = self._shuffle(
-                r, s, win_r, win_s)
+            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
+                self._shuffle(r, s, win_r, win_s, skew_plan)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
             if cfg.two_level or cfg.probe_algorithm == "bucket":
@@ -245,24 +295,35 @@ class HashJoin:
                 # 64-bit keys: three-key lexicographic sort-merge on the
                 # hi/lo uint32 lanes — no device int64, no x64 requirement
                 # (SURVEY.md §7.4 item 3)
+                rk_lo, rk_hi = rp.batch.key, rp.batch.key_hi
+                if hot_batch is not None:
+                    rk_lo = jnp.concatenate([rk_lo, hot_batch.key])
+                    rk_hi = jnp.concatenate([rk_hi, hot_batch.key_hi])
                 counts = merge_count_wide_per_partition(
-                    rp.batch.key, rp.batch.key_hi,
-                    sp.batch.key, sp.batch.key_hi, fanout)
+                    rk_lo, rk_hi, sp.batch.key, sp.batch.key_hi, fanout)
                 local_overflow = jnp.uint32(0)
             else:
-                counts = merge_count_per_partition(
-                    rp.batch.key, sp.batch.key, fanout)
+                rk = rp.batch.key
+                if hot_batch is not None:
+                    # replicated hot build side joins the local probe; its
+                    # padding slots are R sentinels (zero weight)
+                    rk = jnp.concatenate([rk, hot_batch.key])
+                counts = merge_count_per_partition(rk, sp.batch.key, fanout)
                 local_overflow = jnp.uint32(0)
 
             # Failure breakdown, globally reduced (SURVEY.md section 5.3: the
             # reference aborts on any failure; here every mode is counted so
             # the driver can distinguish retryable capacity shortfalls from
-            # contract violations).
+            # contract violations — and grow only the shape that fell short
+            # (the reference sizes each relation's window separately,
+            # Window.cpp:168-177).
             flags = jnp.stack([
                 jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
-                net_overflow.astype(jnp.uint32),
+                lost_r.astype(jnp.uint32),
+                lost_s.astype(jnp.uint32),
                 conserve_bad.astype(jnp.uint32),
                 jax.lax.psum(local_overflow.astype(jnp.uint32), ax),
+                hot_overflow.astype(jnp.uint32),
             ])
             return counts, flags
 
@@ -274,48 +335,112 @@ class HashJoin:
         ))
 
     def _shuffle(self, r: TupleBatch, s: TupleBatch,
-                 win_r: Window, win_s: Window):
+                 win_r: Window, win_s: Window, skew_plan=None):
         """Phases 1-4 (histograms -> assignment -> all_to_all shuffle ->
         conservation checks), shared by the counting and materializing
-        pipelines.  Traced inside shard_map."""
+        pipelines.  Traced inside shard_map.
+
+        With a ``skew_plan`` (hot_bits, hot_cap), hot partitions take the
+        split route (operators/skew.py): hot inner tuples leave the shuffle
+        and come back replicated via all_gather (``hot_batch``), hot outer
+        tuples spread round-robin by rid.  Returns
+        (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad).
+        """
         cfg = self.config
         ax = cfg.mesh_axes
+        n = cfg.num_nodes
         fanout = cfg.network_fanout_bits
-        _, r_hist = compute_local_histogram(r, fanout)
-        _, s_hist = compute_local_histogram(s, fanout)
+        r_pid, r_hist = compute_local_histogram(r, fanout)
+        s_pid, s_hist = compute_local_histogram(s, fanout)
         r_ghist = compute_global_histogram(r_hist, ax)
         s_ghist = compute_global_histogram(s_hist, ax)
-        assignment = compute_partition_assignment(
-            r_ghist, s_ghist, cfg.num_nodes, cfg.assignment_policy)
-        rp = network_partition(r, fanout, assignment, win_r)
-        sp = network_partition(s, fanout, assignment, win_s)
-        lost_r, bad_r = win_r.diagnostics(
-            ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
-            r_ghist, assignment)
-        lost_s, bad_s = win_s.diagnostics(
-            ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
-            s_ghist, assignment)
+
+        hot_batch = None
+        hot_overflow = jnp.uint32(0)
+        if skew_plan:
+            hot_bits, hot_cap = skew_plan
+            # hot partitions leave the normal accounting: assignment and the
+            # per-device conservation targets see them as empty
+            r_gh_eff = skew.mask_hot(r_ghist, hot_bits)
+            s_gh_eff = skew.mask_hot(s_ghist, hot_bits)
+            assignment = compute_partition_assignment(
+                r_gh_eff, s_gh_eff, n, cfg.assignment_policy)
+            is_hot_r = skew.is_hot(r_pid, hot_bits)
+            is_hot_s = skew.is_hot(s_pid, hot_bits)
+            dest_spread = skew.spread_destinations(s.rid, n)
+            rp = network_partition(r, fanout, assignment, win_r,
+                                   exclude=is_hot_r)
+            sp = network_partition(s, fanout, assignment, win_s,
+                                   override=(is_hot_s, dest_spread))
+            # replicate the hot build side: local extraction block +
+            # all_gather (the split's "inner bucket to every execution unit",
+            # kernels_optimized.cu:364-457's shared staging, mesh-wide)
+            hot_blocks, hot_counts, hot_ovf = scatter_to_blocks(
+                r, jnp.zeros_like(r_pid), 1, hot_cap, "inner",
+                valid=is_hot_r)
+            hot_batch = jax.tree.map(
+                lambda x: jax.lax.all_gather(x, ax, tiled=True), hot_blocks)
+            hot_overflow = jax.lax.psum(hot_ovf, ax)
+            lost_r, bad_r = win_r.diagnostics(
+                ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
+                r_gh_eff, assignment)
+            # spread S keeps a per-device expectation: the assigned non-hot
+            # share plus this device's slice of the mesh-wide spread demand
+            # (one extra histogram pass, skew runs only)
+            me = jax.lax.axis_index(ax).astype(jnp.uint32)
+            spread_per_dest = jax.lax.psum(
+                local_histogram(dest_spread, n, valid=is_hot_s), ax)
+            expected_s = (jnp.sum(jnp.where(assignment == me, s_gh_eff, 0))
+                          + spread_per_dest[me])
+            lost_s = jax.lax.psum(sp.send_overflow, ax)
+            bad_s = (jnp.sum(sp.recv_counts) != expected_s) & (lost_s == 0)
+            # hot R conservation: everything extracted+gathered must equal
+            # the hot slice of the global histogram (unless it overflowed)
+            hot_got = jax.lax.psum(
+                jnp.minimum(hot_counts[0], jnp.uint32(hot_cap)), ax)
+            hot_want = jnp.sum(r_ghist) - jnp.sum(r_gh_eff)
+            bad_r = bad_r | ((hot_got != hot_want) & (hot_overflow == 0))
+            r_gh_check, s_gh_check = r_gh_eff, s_gh_eff
+        else:
+            assignment = compute_partition_assignment(
+                r_ghist, s_ghist, n, cfg.assignment_policy)
+            rp = network_partition(r, fanout, assignment, win_r)
+            sp = network_partition(s, fanout, assignment, win_s)
+            lost_r, bad_r = win_r.diagnostics(
+                ExchangeResult(rp.batch, rp.recv_counts, rp.send_overflow),
+                r_ghist, assignment)
+            lost_s, bad_s = win_s.diagnostics(
+                ExchangeResult(sp.batch, sp.recv_counts, sp.send_overflow),
+                s_ghist, assignment)
+            r_gh_check, s_gh_check = r_ghist, s_ghist
+
         if cfg.debug_checks:
             # Per-partition conservation (the strong form of the JOIN_ASSERT
             # invariants, SURVEY.md §4.2-4.3): the received tuples of every
             # assigned partition must match its global histogram entry
             # exactly, not just the totals.  Off by default — an extra
-            # bincount pass per relation over the receive buffers.
+            # bincount pass per relation over the receive buffers.  Hot
+            # partitions are excluded: hot R is withheld (expected 0, which
+            # the masked histogram encodes) and hot S lands by rid spread,
+            # so only its non-hot rows have a per-device expectation.
             me = jax.lax.axis_index(ax).astype(jnp.uint32)
             num_p = r_ghist.shape[0]
+            hot_rows = (skew.is_hot(jnp.arange(num_p, dtype=jnp.uint32),
+                                    skew_plan[0])
+                        if skew_plan else jnp.zeros((num_p,), bool))
             pp_bad = jnp.bool_(False)
-            for part, ghist, lost in ((rp, r_ghist, lost_r),
-                                      (sp, s_ghist, lost_s)):
+            for part, ghist, lost in ((rp, r_gh_check, lost_r),
+                                      (sp, s_gh_check, lost_s)):
                 got_pp = jnp.bincount(
                     jnp.where(part.valid, part.pid, num_p).astype(jnp.int32),
                     length=num_p + 1)[:num_p].astype(jnp.uint32)
                 want_pp = jnp.where(assignment == me, ghist, 0)
-                pp_bad = pp_bad | (jnp.any(got_pp != want_pp) & (lost == 0))
+                row_bad = (got_pp != want_pp) & ~hot_rows
+                pp_bad = pp_bad | (jnp.any(row_bad) & (lost == 0))
             bad_r = bad_r | pp_bad   # same failure class: misrouting
-        net_overflow = lost_r + lost_s                       # already psum'd
         conserve_bad = jax.lax.psum(
             bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)
-        return rp, sp, net_overflow, conserve_bad
+        return rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad
 
     def _materialize_fn(self, cap_r: int, cap_s: int, rate_cap: int):
         """Pipeline variant that emits rid pairs instead of counts — the
@@ -331,15 +456,18 @@ class HashJoin:
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
                 jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
-            rp, sp, net_overflow, conserve_bad = self._shuffle(
+            rp, sp, _, lost_r, lost_s, _, conserve_bad = self._shuffle(
                 r, s, win_r, win_s)
             m = probe_materialize(_as_compressed(rp.batch),
                                   _as_compressed(sp.batch), rate_cap)
+            zero = jnp.uint32(0)
             flags = jnp.stack([
                 jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
-                net_overflow.astype(jnp.uint32),
+                lost_r.astype(jnp.uint32),
+                lost_s.astype(jnp.uint32),
                 conserve_bad.astype(jnp.uint32),
                 jax.lax.psum(m.overflow.astype(jnp.uint32), ax),
+                zero,
             ])
             return m.r_rid, m.s_rid, m.valid, flags
 
@@ -351,30 +479,36 @@ class HashJoin:
         ))
 
     def _get_compiled(self, r: TupleBatch, s: TupleBatch,
-                      cap_r: int, cap_s: int, local_slack: int = 1):
+                      cap_r: int, cap_s: int, local_slack: int = 1,
+                      skew_plan=None):
         """AOT-compiled pipeline executable for these shapes/capacities.
 
         Ahead-of-time ``lower().compile()`` keeps XLA compilation out of the
         JPROC execution timer (the reference's phase timers never include
         compilation — there is none at runtime)."""
         n = self.config.num_nodes
-        key = (r.size // n, s.size // n, cap_r, cap_s, local_slack,
+        key = (r.size // n, s.size // n, cap_r, cap_s, local_slack, skew_plan,
                r.key_hi is None, s.key_hi is None,
                getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         if key not in self._compiled:
             fn = self._pipeline_fn(r.size // n, s.size // n, cap_r, cap_s,
-                                   local_slack)
+                                   local_slack, skew_plan)
             self._compiled[key] = fn.lower(r, s).compile()
         return self._compiled[key]
 
     @staticmethod
     def _flags_to_diag(flags: np.ndarray) -> dict:
-        """Failure breakdown from the pipeline's reduced flag vector."""
+        """Failure breakdown from the pipeline's reduced flag vector.  The
+        two shuffle overflows are per relation so a retry grows only the
+        window that fell short (the reference sizes them separately,
+        Window.cpp:168-177)."""
         return {
-            "key_contract_violations": int(flags[0]),  # nodes with out-of-range keys
-            "shuffle_overflow_tuples": int(flags[1]),  # block capacity shortfall
-            "conservation_violations": int(flags[2]),  # nodes with misrouted counts
-            "local_overflow": int(flags[3]),           # bucket / match-cap shortfall
+            "key_contract_violations": int(flags[0]),   # nodes with out-of-range keys
+            "shuffle_overflow_r_tuples": int(flags[1]),  # inner block capacity shortfall
+            "shuffle_overflow_s_tuples": int(flags[2]),  # outer block capacity shortfall
+            "conservation_violations": int(flags[3]),   # nodes with misrouted counts
+            "local_overflow": int(flags[4]),            # bucket / match-cap shortfall
+            "hot_overflow": int(flags[5]),              # skew replication buffer shortfall
         }
 
     @staticmethod
@@ -383,10 +517,11 @@ class HashJoin:
         conservation violations are not (the reference aborts on everything,
         Debug.h:27-37 — the retry is this framework's shape-specialization
         answer to runtime-sized windows, SURVEY.md section 7.4 item 1)."""
-        return (diag["shuffle_overflow_tuples"] > 0
-                or diag["local_overflow"] > 0) and (
-                    diag["key_contract_violations"] == 0
-                    and diag["conservation_violations"] == 0)
+        capacity = (diag["shuffle_overflow_r_tuples"]
+                    or diag["shuffle_overflow_s_tuples"]
+                    or diag["local_overflow"] or diag["hot_overflow"])
+        return bool(capacity) and (diag["key_contract_violations"] == 0
+                                   and diag["conservation_violations"] == 0)
 
     # ------------------------------------------------------------------- run
     def join_arrays(self, r: TupleBatch, s: TupleBatch) -> JoinResult:
@@ -404,7 +539,7 @@ class HashJoin:
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
-        cap_r, cap_s = self._measure_capacities(
+        cap_r, cap_s, skew_plan = self._measure_capacities(
             r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
@@ -412,7 +547,7 @@ class HashJoin:
         for attempt in range(self.config.max_retries + 1):
             if m:
                 m.start("JCOMPILE")
-            fn = self._get_compiled(r, s, cap_r, cap_s, local_slack)
+            fn = self._get_compiled(r, s, cap_r, cap_s, local_slack, skew_plan)
             if m:
                 m.stop("JCOMPILE")
                 m.start("JPROC")
@@ -425,10 +560,14 @@ class HashJoin:
                 break
             # capacity shortfall: double only the shapes that fell short and
             # respecialize (detect-and-retry, SURVEY.md section 7.4 item 1)
-            if diag["shuffle_overflow_tuples"]:
-                cap_r, cap_s = 2 * cap_r, 2 * cap_s
+            if diag["shuffle_overflow_r_tuples"]:
+                cap_r *= 2
+            if diag["shuffle_overflow_s_tuples"]:
+                cap_s *= 2
             if diag["local_overflow"]:
                 local_slack *= 2
+            if diag["hot_overflow"]:
+                skew_plan = (skew_plan[0], 2 * skew_plan[1])
             if m:
                 m.incr("RETRIES")
         counts = np.asarray(counts)
@@ -438,8 +577,11 @@ class HashJoin:
             m.incr("RESULTS", matches)
             m.incr("RTUPLES", r.size)
             m.incr("STUPLES", s.size)
-            m.record_exchange(n, cap_r, cap_s,
-                              tuple_bytes=8 if r.key_hi is None else 12)
+            if not self._single_node_sort_probe():
+                # the n==1 specialization performs no exchange at all —
+                # recording its dummy capacities would invent network stats
+                m.record_exchange(n, cap_r, cap_s,
+                                  tuple_bytes=8 if r.key_hi is None else 12)
             m.derive_rates()
         return JoinResult(matches=matches, ok=not flags.any(),
                           partition_counts=counts, diagnostics=diag)
@@ -456,11 +598,15 @@ class HashJoin:
             raise NotImplementedError(
                 "materializing probe has no chunked variant; unset chunk_size "
                 "(the count path honors it)")
+        if self.config.skew_threshold is not None:
+            raise NotImplementedError(
+                "materializing probe has no skew-split path; unset "
+                "skew_threshold (the count path honors it)")
         m = self.measurements
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
-        cap_r, cap_s = self._measure_capacities(r, s)
+        cap_r, cap_s, _ = self._measure_capacities(r, s)
         if m:
             m.stop("SWINALLOC")
         rate_cap = self.config.match_rate_cap
@@ -484,8 +630,10 @@ class HashJoin:
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
-            if diag["shuffle_overflow_tuples"]:
-                cap_r, cap_s = 2 * cap_r, 2 * cap_s
+            if diag["shuffle_overflow_r_tuples"]:
+                cap_r *= 2
+            if diag["shuffle_overflow_s_tuples"]:
+                cap_s *= 2
             if diag["local_overflow"]:        # match-rate cap shortfall
                 rate_cap *= 2
             if m:

@@ -1,0 +1,78 @@
+"""Hot-partition skew splitting.
+
+The TPU-native counterpart of the reference's probe-level skew machinery
+(``operators/gpu/kernels_optimized.cu:301-344`` skew_detect + block remapping,
+``:364-672`` probe_skew variants, ``:864-943`` dynamic-parallelism child
+kernels): partitions whose weight exceeds a threshold get more execution
+resources than the default one-partition-one-owner mapping allows.
+
+Assignment-level balancing (histograms/assignment_map.py) cannot help a
+*single* dominant partition — all its tuples land on one device whatever the
+map says.  The split here changes the data movement instead (SURVEY.md §5.7
+"skew splitting becomes capacity-padded buckets + a second-chance pass",
+refined): for each detected hot partition
+
+  * the INNER (build) side is **replicated**: every device extracts its local
+    hot-R tuples into a capacity-padded block and an ``all_gather`` hands every
+    device the full hot build side;
+  * the OUTER (probe) side is **sharded**: hot-S tuples ignore the assignment
+    map and spread round-robin by rid across all devices;
+  * each device probes its S shard against the replicated R and the
+    per-partition counts ``psum``/host-sum to the exact global total (every S
+    tuple meets the full hot R exactly once).
+
+Detection is a host-side decision on the (already computed) global histograms
+— the shape-specialization philosophy of the pipeline: the hot set is baked
+into the compiled program as a constant, like the reference bakes its skew
+threshold into ``skew_detect`` (kernels_optimized.cu:301-311).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# The in-program hot test is a vectorized bit probe against one uint32
+# constant, so the splittable fanout is capped at 32 partitions (the
+# reference's default NETWORK_PARTITIONING_COUNT, Configuration.h:33).
+MAX_SKEW_PARTITIONS = 32
+
+
+def detect_hot_partitions(r_ghist: np.ndarray, s_ghist: np.ndarray,
+                          threshold: float) -> np.ndarray:
+    """bool [P]: partitions whose combined (R+S) global weight exceeds
+    ``threshold`` x the mean partition weight (skew_detect's
+    blocks-per-partition criterion, kernels_optimized.cu:301-311, reduced to
+    a binary split/don't-split decision)."""
+    w = r_ghist.astype(np.float64) + s_ghist.astype(np.float64)
+    return w > threshold * w.mean()
+
+
+def hot_mask_bits(hot: np.ndarray) -> int:
+    """Pack a bool [P<=32] mask into the uint32 program constant."""
+    if hot.shape[0] > MAX_SKEW_PARTITIONS:
+        raise ValueError(
+            f"skew splitting supports at most {MAX_SKEW_PARTITIONS} "
+            f"network partitions, got {hot.shape[0]}")
+    return sum(1 << i for i, h in enumerate(hot) if h)
+
+
+def is_hot(pid: jnp.ndarray, hot_bits: int) -> jnp.ndarray:
+    """Vectorized membership test: bool [n] for uint32 partition ids."""
+    return ((jnp.uint32(hot_bits) >> pid) & jnp.uint32(1)) == jnp.uint32(1)
+
+
+def spread_destinations(rid: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
+    """Destination for hot outer tuples: round-robin by rid — dense rids give
+    an exactly balanced shard, arbitrary rids a hash-balanced one (the analog
+    of generate_block_mapping distributing a hot partition's chunks over
+    blocks, kernels_optimized.cu:321-344)."""
+    return rid % jnp.uint32(num_nodes)
+
+
+def mask_hot(hist: jnp.ndarray, hot_bits: int) -> jnp.ndarray:
+    """Zero the hot partitions of a [P] histogram: hot partitions leave the
+    normal assignment/window accounting entirely."""
+    p = hist.shape[0]
+    hot = is_hot(jnp.arange(p, dtype=jnp.uint32), hot_bits)
+    return jnp.where(hot, jnp.zeros_like(hist), hist)

@@ -43,10 +43,22 @@ def network_partition(
     assignment: jnp.ndarray,
     window: Window,
     valid: jnp.ndarray | None = None,
+    exclude: jnp.ndarray | None = None,
+    override: Tuple[jnp.ndarray, jnp.ndarray] | None = None,
 ) -> NetworkPartitionResult:
-    """Runs inside shard_map over the mesh axis."""
+    """Runs inside shard_map over the mesh axis.
+
+    ``exclude``: bool [n] — tuples withheld from the shuffle (the skew split
+    pulls hot inner tuples out for replication instead, operators/skew.py).
+    ``override``: (mask, dest) — tuples whose destination ignores the
+    assignment map (hot outer tuples spread round-robin).
+    """
     pid = partition_ids(batch, fanout_bits)
     dest = assignment[pid]
+    if override is not None:
+        dest = jnp.where(override[0], override[1], dest)
+    if exclude is not None:
+        valid = ~exclude if valid is None else (valid & ~exclude)
     res: ExchangeResult = window.exchange(batch, dest, valid=valid)
     recv_valid = valid_mask(res.batch, window.side)
     recv_pid = partition_ids(res.batch, fanout_bits)
