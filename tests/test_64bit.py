@@ -1,9 +1,10 @@
 """64-bit key support: hi/lo uint32 lanes through the full pipeline.
 
 The 1B CompressedTuple config (BASELINE.md #5) uses int64 keys; on TPU these
-ride as two uint32 lanes.  The pipeline probes them with a three-key
-lexicographic sort-merge (no device int64, no jax x64); the packed-uint64
-searchsorted ops in ops/build_probe.py remain for x64-enabled hosts."""
+ride as two uint32 lanes.  Every probe discipline — sort-merge, bucketized
+(three-key batched row sort), chunked, materializing — compares (hi, lo)
+pairs lexicographically: no device int64, no jax x64 anywhere (SURVEY.md
+§7.4 item 3).  Every test here runs with x64 OFF and asserts so."""
 
 import jax
 import jax.numpy as jnp
@@ -11,15 +12,8 @@ import numpy as np
 import pytest
 
 from tpu_radix_join import HashJoin, JoinConfig
-from tpu_radix_join.data.tuples import TupleBatch, compress, decompress, partition_ids
-
-
-@pytest.fixture
-def x64():
-    old = jax.config.jax_enable_x64
-    jax.config.update("jax_enable_x64", True)
-    yield
-    jax.config.update("jax_enable_x64", old)
+from tpu_radix_join.data.tuples import (
+    CompressedBatch, TupleBatch, compress, decompress, partition_ids)
 
 
 def _batch64(keys64: np.ndarray) -> TupleBatch:
@@ -31,6 +25,10 @@ def _batch64(keys64: np.ndarray) -> TupleBatch:
     )
 
 
+def _comp64(b: TupleBatch) -> CompressedBatch:
+    return CompressedBatch(key_rem=b.key, rid=b.rid, key_rem_hi=b.key_hi)
+
+
 def _host_count(r64, s64):
     rs = np.sort(r64)
     lo = np.searchsorted(rs, s64, side="left")
@@ -38,24 +36,40 @@ def _host_count(r64, s64):
     return int((hi - lo).sum())
 
 
-def test_probe_count_64bit(x64):
+def test_no_x64_anywhere():
+    assert not jax.config.jax_enable_x64
+
+
+def test_probe_count_64bit():
     from tpu_radix_join.ops.build_probe import probe_count
     rng = np.random.default_rng(0)
     r64 = (rng.integers(0, 1 << 40, 4000, dtype=np.uint64)
            | (np.uint64(1) << np.uint64(33)))
     s64 = rng.choice(r64, 3000)
-    rb, sb = _batch64(r64), _batch64(s64)
-    rc = compress(rb, 0)
-    sc = compress(sb, 0)
-    rc = rc._replace(key_rem_hi=rb.key_hi)
-    sc = sc._replace(key_rem_hi=sb.key_hi)
-    got = int(probe_count(rc, sc))
+    got = int(probe_count(_comp64(_batch64(r64)), _comp64(_batch64(s64))))
     assert got == _host_count(r64, s64)
 
 
-def test_hi_lane_distinguishes_keys(x64):
+def test_probe_count_per_partition_64bit():
+    from tpu_radix_join.ops.build_probe import probe_count_per_partition
+    rng = np.random.default_rng(8)
+    r64 = rng.integers(0, 1 << 38, 3000, dtype=np.uint64)
+    s64 = np.concatenate([rng.choice(r64, 1500),
+                          rng.integers(0, 1 << 38, 1500, dtype=np.uint64)])
+    sb = _batch64(s64)
+    pid = sb.key & jnp.uint32(7)
+    got = np.asarray(probe_count_per_partition(
+        _comp64(_batch64(r64)), _comp64(sb), pid, 8)).astype(np.uint64)
+    want = np.zeros(8, np.uint64)
+    rs = np.sort(r64)
+    cnt = (np.searchsorted(rs, s64, "right") - np.searchsorted(rs, s64, "left"))
+    for k, c in zip(s64, cnt):
+        want[int(k) & 7] += c
+    np.testing.assert_array_equal(got, want)
+
+
+def test_hi_lane_distinguishes_keys():
     from tpu_radix_join.ops.build_probe import probe_count
-    from tpu_radix_join.data.tuples import CompressedBatch
     # same low lane, different hi lane: must NOT match
     r = CompressedBatch(key_rem=jnp.asarray([5], jnp.uint32),
                         rid=jnp.asarray([0], jnp.uint32),
@@ -66,7 +80,7 @@ def test_hi_lane_distinguishes_keys(x64):
     assert int(probe_count(r, s)) == 0
 
 
-def test_distributed_join_64bit(x64):
+def test_distributed_join_64bit():
     rng = np.random.default_rng(3)
     n = 1 << 12
     r64 = rng.permutation(n).astype(np.uint64) | (np.uint64(1) << np.uint64(35))
@@ -77,7 +91,7 @@ def test_distributed_join_64bit(x64):
     assert res.matches == n
 
 
-def test_compress_roundtrip_is_exact_64(x64):
+def test_compress_roundtrip_is_exact_64():
     rng = np.random.default_rng(4)
     k64 = rng.integers(0, 1 << 50, 1000, dtype=np.uint64)
     b = _batch64(k64)
@@ -88,11 +102,8 @@ def test_compress_roundtrip_is_exact_64(x64):
     np.testing.assert_array_equal(got, k64)
 
 
-def test_wide_merge_count_no_x64():
-    """The three-key lexicographic path needs no jax x64 — the contract that
-    makes 64-bit keys TPU-native (SURVEY.md §7.4 item 3)."""
+def test_wide_merge_count():
     from tpu_radix_join.ops.merge_count import merge_count_wide_per_partition
-    assert not jax.config.jax_enable_x64
     rng = np.random.default_rng(3)
     r64 = rng.integers(0, 1 << 40, 4096, dtype=np.uint64)
     s64 = np.concatenate([r64[:2048],
@@ -112,9 +123,84 @@ def test_wide_merge_count_no_x64():
     np.testing.assert_array_equal(got.astype(np.uint64), want)
 
 
+@pytest.mark.parametrize("fanout", [0, 4])
+def test_wide_partition_kernel_matches_xla(fanout):
+    # interpret-mode parity for the wide fused Pallas kernel (the TPU path)
+    from tpu_radix_join.ops.merge_count import merge_count_wide_per_partition
+    from tpu_radix_join.ops.pallas.merge_scan import TILE
+    rng = np.random.default_rng(fanout + 1)
+    r64 = rng.integers(0, 1 << 36, TILE + 100, dtype=np.uint64)
+    s64 = np.concatenate([rng.choice(r64, TILE // 2),
+                          rng.integers(0, 1 << 36, 77, dtype=np.uint64)])
+    rb, sb = _batch64(r64), _batch64(s64)
+    a = merge_count_wide_per_partition(rb.key, rb.key_hi, sb.key, sb.key_hi,
+                                       fanout, impl="xla")
+    b = merge_count_wide_per_partition(rb.key, rb.key_hi, sb.key, sb.key_hi,
+                                       fanout, impl="pallas_interpret")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_two_level_64bit():
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=4, local_fanout_bits=4,
+                     two_level=True, key_bits=64, allocation_factor=2.0)
+    rng = np.random.default_rng(6)
+    size = 1 << 12
+    r64 = rng.permutation(size).astype(np.uint64) | (np.uint64(3) << 33)
+    s64 = rng.permutation(size).astype(np.uint64) | (np.uint64(3) << 33)
+    res = HashJoin(cfg).join_arrays(_batch64(r64), _batch64(s64))
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+
+
+def test_chunked_64bit():
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=4, key_bits=64,
+                     chunk_size=256)
+    rng = np.random.default_rng(7)
+    size = 1 << 12
+    r64 = rng.integers(0, 1 << 39, size, dtype=np.uint64)
+    s64 = np.concatenate([rng.choice(r64, size // 2),
+                          rng.integers(0, 1 << 39, size // 2, dtype=np.uint64)])
+    res = HashJoin(cfg).join_arrays(_batch64(r64), _batch64(s64))
+    assert res.ok, res.diagnostics
+    assert res.matches == _host_count(r64, s64)
+
+
+def test_materialize_64bit():
+    # inner repeats keys 4x -> every outer hit materializes 4 rid pairs
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=4, key_bits=64,
+                     match_rate_cap=4)
+    size = 1 << 10
+    base = (np.arange(size // 4, dtype=np.uint64) | (np.uint64(5) << 37))
+    r64 = np.tile(base, 4)
+    s64 = np.concatenate([base[: size // 8],
+                          (np.arange(size // 8, dtype=np.uint64)
+                           | (np.uint64(9) << 37))])
+    res = HashJoin(cfg).join_materialize(_rel64(r64), _rel64(s64))
+    assert res.ok, res.diagnostics
+    assert res.matches == (size // 8) * 4
+    # every returned pair is a true match under the full 64-bit key
+    rmap = {i: k for i, k in enumerate(r64)}
+    smap = {i: k for i, k in enumerate(s64)}
+    for rr, sr in zip(res.r_rid, res.s_rid):
+        assert rmap[int(rr)] == smap[int(sr)]
+
+
+def _rel64(keys64):
+    """Adapter: join_materialize takes Relations; wrap raw arrays."""
+    class _Fixed:
+        def __init__(self, k):
+            self.k = k
+            self.num_nodes = 4
+        def shard_np(self, i):
+            n = len(self.k) // 4
+            sl = self.k[i * n:(i + 1) * n]
+            return ((sl & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                    np.arange(i * n, (i + 1) * n, dtype=np.uint32))
+    return _Fixed(keys64)
+
+
 def test_pipeline_64bit_no_x64():
     """Full distributed join on 64-bit keys with x64 DISABLED."""
-    assert not jax.config.jax_enable_x64
     n = 4
     cfg = JoinConfig(num_nodes=n, network_fanout_bits=4, key_bits=64)
     rng = np.random.default_rng(11)

@@ -270,10 +270,6 @@ class HashJoin:
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
             if cfg.two_level or cfg.probe_algorithm == "bucket":
-                if r.key_hi is not None:
-                    raise NotImplementedError(
-                        "bucketized probe compares the 32-bit key lane only; "
-                        "use probe_algorithm='sort' for 64-bit keys")
                 nb = cfg.local_partition_count
                 lcap_r = cfg.bucket_capacity(n * cap_r, nb) * local_slack
                 lcap_s = cfg.bucket_capacity(n * cap_s, nb) * local_slack
@@ -281,9 +277,15 @@ class HashJoin:
                                      cfg.local_fanout_bits, lcap_r, "inner")
                 ls = local_partition(sp.batch, sp.valid, fanout,
                                      cfg.local_fanout_bits, lcap_s, "outer")
+                # wide keys: hi lanes ride the same blocks; the probe's
+                # three-key batched row sort compares full (hi, lo) pairs
                 counts = probe_count_bucketized(
                     lr.blocks.key.reshape(nb, lcap_r),
-                    ls.blocks.key.reshape(nb, lcap_s))
+                    ls.blocks.key.reshape(nb, lcap_s),
+                    None if r.key_hi is None
+                    else lr.blocks.key_hi.reshape(nb, lcap_r),
+                    None if s.key_hi is None
+                    else ls.blocks.key_hi.reshape(nb, lcap_s))
                 local_overflow = lr.overflow + ls.overflow
             elif cfg.chunk_size:
                 # out-of-core discipline (LD kernels): outer slabs under scan

@@ -45,17 +45,15 @@ from tpu_radix_join.ops.sorting import (
 
 
 def _sort_key(comp: CompressedBatch) -> jnp.ndarray:
-    """Single-lane comparable key for sort/searchsorted.
+    """Single-lane comparable key for sort/searchsorted — 32-bit keys only.
 
-    64-bit remainders need a uint64 lane; JAX x64 must be enabled for that
-    path (the 1B CompressedTuple config).  32-bit keys stay uint32.
-    """
-    if comp.key_rem_hi is None:
-        return comp.key_rem
-    if not jax.config.jax_enable_x64:
-        raise NotImplementedError(
-            "64-bit probe keys require jax_enable_x64 (uint64 sort lane)")
-    return (comp.key_rem_hi.astype(jnp.uint64) << 32) | comp.key_rem.astype(jnp.uint64)
+    Wide (64-bit) keys have no single uint32 lane and device int64 is
+    off-limits (SURVEY.md §7.4 item 3); every probe entry point routes them
+    to the hi/lo lexicographic disciplines instead (``_wide_weights`` /
+    ``merge_count_wide_per_partition``), so this helper is never reached
+    with a wide batch."""
+    assert comp.key_rem_hi is None, "wide keys take the lexicographic paths"
+    return comp.key_rem
 
 
 def _probe_bounds(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
@@ -66,13 +64,54 @@ def _probe_bounds(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> Tuple[jnp.ndarray
     return r_sorted, lo, hi
 
 
+def _wide_union_scan(inner: CompressedBatch, outer: CompressedBatch,
+                     *carried: jnp.ndarray):
+    """Rank-space scan of the (hi, lo) union: the wide-key replacement for
+    searchsorted, which has no pair-key form without a device uint64 lane
+    (SURVEY.md §7.4 item 3).
+
+    One three-key lexicographic sort of both sides — (hi, lo, side-tag), the
+    tag keeping every equal-key run's R tuples ahead of its S tuples — then
+    the cumsum/cummax pass of ops/merge_count.  At each OUTER position,
+    ``[base, c_r)`` is exactly its matching inner index range in
+    sorted-inner-only coordinates (all of a run's inner tuples precede its
+    outer tuples, and inner relative order matches a standalone inner sort).
+
+    ``carried`` lanes ([n_outer] each, padded with PAD_RID at inner slots)
+    ride through the sort.  Returns (is_outer u32, base, c_r, *carried_sorted)
+    — all int32 ranks except the uint32 tag/carried.
+    """
+    n_r = inner.size
+    hi = jnp.concatenate([inner.key_rem_hi, outer.key_rem_hi])
+    lo = jnp.concatenate([inner.key_rem, outer.key_rem])
+    tag = jnp.concatenate([jnp.zeros((n_r,), jnp.uint32),
+                           jnp.ones((outer.size,), jnp.uint32)])
+    pad_lane = jnp.full((n_r,), 0xFFFFFFFF, jnp.uint32)
+    carried_full = [jnp.concatenate([pad_lane, c]) for c in carried]
+    out = sort_lex_unstable(hi, lo, tag, *carried_full, num_keys=3)
+    hi, lo, tag, carried_sorted = out[0], out[1], out[2], out[3:]
+
+    prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), hi[:-1]])
+    prev_lo = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), lo[:-1]])
+    run_start = (hi != prev_hi) | (lo != prev_lo)
+    is_r = (jnp.uint32(1) - tag).astype(jnp.int32)
+    c_r = jnp.cumsum(is_r, dtype=jnp.int32)
+    base_at_start = jnp.where(run_start, c_r - is_r, 0)
+    base = jax.lax.cummax(base_at_start)
+    return (tag, base, c_r) + tuple(carried_sorted)
+
+
 def probe_count(inner: CompressedBatch, outer: CompressedBatch) -> jnp.ndarray:
     """Exact number of matching (r, s) pairs, as uint32.
 
     Handles duplicate keys on both sides (count per outer tuple = size of its
     equal-key run in the sorted inner side).  Padding sentinels fall out: no
     real or padded outer key ever equals an inner sentinel and vice versa.
+    64-bit keys take the lexicographic union scan — no x64.
     """
+    if inner.key_rem_hi is not None:
+        tag, base, c_r = _wide_union_scan(inner, outer)
+        return jnp.sum((tag.astype(jnp.int32) * (c_r - base)).astype(jnp.uint32))
     _, lo, hi = _probe_bounds(_sort_key(inner), _sort_key(outer))
     return jnp.sum((hi - lo).astype(jnp.uint32))
 
@@ -96,8 +135,17 @@ def probe_count_per_partition(
     """Per-partition match counts, uint32 [num_partitions].
 
     Keeps each accumulator < 2**32 so host-side uint64 summation is exact even
-    at billions of total matches (see module docstring).
+    at billions of total matches (see module docstring).  Wide keys carry the
+    partition id through the union sort and weight-sum per partition.
     """
+    if inner.key_rem_hi is not None:
+        tag, base, c_r, pid = _wide_union_scan(inner, outer, outer_pid)
+        weight = tag.astype(jnp.int32) * (c_r - base)
+        # inner slots carry the PAD_RID pid lane but tag=0 zeroes their weight
+        return jnp.bincount(
+            jnp.minimum(pid, jnp.uint32(num_partitions)).astype(jnp.int32),
+            weights=weight.astype(jnp.uint32),
+            length=num_partitions + 1)[:num_partitions].astype(jnp.uint32)
     return _per_partition_counts(sort_unstable(_sort_key(inner)),
                                  _sort_key(outer), outer_pid, num_partitions)
 
@@ -116,18 +164,42 @@ def probe_count_chunked(
     Identical results to :func:`probe_count_per_partition` (tested); the
     outer buffer is padded to a slab multiple with S-side sentinels, which
     match nothing by the pad-key contract (tuples.py).
+
+    Wide keys: the narrow path's resident-sorted-inner + searchsorted trick
+    has no pair-key form, so each slab runs the lexicographic union scan
+    against the inner side instead — the inner re-sorts per slab (more
+    compute), but the per-step working set keeps the LD contract:
+    O(inner + slab) live sort buffers regardless of the outer size.
     """
+    n = outer.size
+    pad = (-n) % slab_size
+    fill = int(pad_sentinel("outer"))
+    if inner.key_rem_hi is not None:
+        s_lo, s_hi = outer.key_rem, outer.key_rem_hi
+        if pad:
+            # pad BOTH lanes with the sentinel (the make_padding(wide=True)
+            # contract): 0x00000000_FFFFFFFF would be a legal real key
+            pad_lane = jnp.full((pad,), fill, jnp.uint32)
+            s_lo = jnp.concatenate([s_lo, pad_lane])
+            s_hi = jnp.concatenate([s_hi, pad_lane])
+            outer_pid = jnp.concatenate(
+                [outer_pid, jnp.zeros((pad,), outer_pid.dtype)])
+
+        def step_wide(carry, slab):
+            lo, hi, pid = slab
+            slab_batch = CompressedBatch(key_rem=lo, rid=pid, key_rem_hi=hi)
+            return carry, probe_count_per_partition(
+                inner, slab_batch, pid, num_partitions)
+
+        _, per_slab = jax.lax.scan(
+            step_wide, (), (s_lo.reshape(-1, slab_size),
+                            s_hi.reshape(-1, slab_size),
+                            outer_pid.reshape(-1, slab_size)))
+        return jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
+
     r_sorted = sort_unstable(_sort_key(inner))
     sk = _sort_key(outer)
-    n = sk.shape[0]
-    pad = (-n) % slab_size
     if pad:
-        # 64-bit sort keys pad BOTH lanes with the sentinel (the
-        # make_padding(wide=True) contract): 0x00000000_FFFFFFFF would be a
-        # legal real key.
-        fill = int(pad_sentinel("outer"))
-        if outer.key_rem_hi is not None:
-            fill = (fill << 32) | fill
         sk = jnp.concatenate([sk, jnp.full((pad,), fill, sk.dtype)])
         outer_pid = jnp.concatenate(
             [outer_pid, jnp.zeros((pad,), outer_pid.dtype)])
@@ -153,10 +225,13 @@ DENSE_BUCKET_LIMIT = 256
 
 
 def probe_count_bucketized(
-    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray
+    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
+    inner_hi: jnp.ndarray | None = None,
+    outer_hi: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Per-bucket match counts, uint32 [nb], for sentinel-padded key blocks
-    inner_blocks [nb, bi] / outer_blocks [nb, bo].
+    inner_blocks [nb, bi] / outer_blocks [nb, bo] (wide keys add the matching
+    hi-lane blocks).
 
     Auto-selects the discipline: the O(bi*bo) dense equality reduction (the
     GPU shared-memory probe analog, kernels.cu:199-246) for tiny buckets,
@@ -166,21 +241,27 @@ def probe_count_bucketized(
     """
     if max(inner_blocks.shape[1], outer_blocks.shape[1]) <= DENSE_BUCKET_LIMIT:
         eq = inner_blocks[:, :, None] == outer_blocks[:, None, :]
+        if inner_hi is not None:
+            eq &= inner_hi[:, :, None] == outer_hi[:, None, :]
         return jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
-    return probe_count_bucketized_merge(inner_blocks, outer_blocks)
+    return probe_count_bucketized_merge(inner_blocks, outer_blocks,
+                                        inner_hi, outer_hi)
 
 
 def probe_count_bucketized_merge(
-    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray
+    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
+    inner_hi: jnp.ndarray | None = None,
+    outer_hi: jnp.ndarray | None = None,
 ) -> jnp.ndarray:
     """Batched per-bucket sort-merge counting (same contract as
     :func:`probe_count_bucketized`).
 
-    Each bucket row is sorted lexicographically by (key, side-tag) in one
-    batched two-key ``lax.sort`` over axis 1 — full 32-bit keys, no packing
-    limit — then the merge-count weight scan (cumsum/cummax of
-    ops/merge_count) runs along the rows.  R/S pad sentinels differ
-    (tuples.py), so padding forms its own runs and contributes zero.
+    Each bucket row is sorted lexicographically by (key, side-tag) — or
+    (hi, lo, side-tag) for wide keys, the three-key batched row sort — in
+    one batched ``lax.sort`` over axis 1, then the merge-count weight scan
+    (cumsum/cummax of ops/merge_count) runs along the rows.  R/S pad
+    sentinels differ (tuples.py), so padding forms its own runs and
+    contributes zero.
     """
     from tpu_radix_join.ops.merge_count import _run_weights
     nb = inner_blocks.shape[0]
@@ -188,12 +269,20 @@ def probe_count_bucketized_merge(
     tag = jnp.concatenate([
         jnp.zeros(inner_blocks.shape, jnp.uint32),
         jnp.ones(outer_blocks.shape, jnp.uint32)], axis=1)
-    keys, tag = sort_lex_unstable(keys, tag, num_keys=2, dimension=1)
-    prev = jnp.concatenate(
-        [jnp.full((nb, 1), 0xFFFFFFFF, jnp.uint32), keys[:, :-1]], axis=1)
+    fill = jnp.full((nb, 1), 0xFFFFFFFF, jnp.uint32)
+    if inner_hi is not None:
+        his = jnp.concatenate([inner_hi, outer_hi], axis=1)
+        his, keys, tag = sort_lex_unstable(his, keys, tag, num_keys=3,
+                                           dimension=1)
+        prev_hi = jnp.concatenate([fill, his[:, :-1]], axis=1)
+        prev_lo = jnp.concatenate([fill, keys[:, :-1]], axis=1)
+        run_start = (his != prev_hi) | (keys != prev_lo)
+    else:
+        keys, tag = sort_lex_unstable(keys, tag, num_keys=2, dimension=1)
+        run_start = keys != jnp.concatenate([fill, keys[:, :-1]], axis=1)
     # vmap the 1-D weight scan over bucket rows (cumsum/cummax are along the
     # row, independent per bucket)
-    weights = jax.vmap(_run_weights)(tag, keys != prev)
+    weights = jax.vmap(_run_weights)(tag, run_start)
     return jnp.sum(weights, axis=1, dtype=jnp.uint32)
 
 
@@ -210,15 +299,35 @@ def probe_materialize(
     """Materialize matching rid pairs, up to ``cap`` matches per outer tuple.
 
     The analog of ``probe_match_rate`` (kernels.cu:314-411): a static output
-    buffer of ``n_outer * cap`` pairs plus an overflow indicator standing in
-    for the kernel's retry flag ``pFlag``.
+    buffer (``n_outer * cap`` pairs for 32-bit keys, union-length x cap for
+    wide — inner positions emit valid=False rows) plus an overflow indicator
+    standing in for the kernel's retry flag ``pFlag``.  Wide keys: the
+    union scan's [base, c_r) ranks index sorted-inner order directly, no
+    searchsorted, no x64.
     """
+    k = jnp.arange(cap, dtype=jnp.int32)[None, :]              # [1, cap]
+    if inner.key_rem_hi is not None:
+        _, _, r_rid_sorted = sort_lex_unstable(
+            inner.key_rem_hi, inner.key_rem, inner.rid, num_keys=2)
+        tag, base, c_r, s_rid_sorted = _wide_union_scan(inner, outer,
+                                                        outer.rid)
+        is_outer = tag.astype(jnp.int32)
+        idx = base[:, None] + k                                # [n_union, cap]
+        valid = (idx < c_r[:, None]) & (is_outer[:, None] == 1)
+        idx = jnp.minimum(idx, inner.size - 1)
+        r_rid = r_rid_sorted[idx]
+        s_rid = jnp.broadcast_to(s_rid_sorted[:, None], idx.shape)
+        overflow = jnp.sum((((c_r - base) > cap) & (is_outer == 1))
+                           .astype(jnp.uint32))
+        return MaterializedMatches(
+            r_rid=r_rid.reshape(-1), s_rid=s_rid.reshape(-1),
+            valid=valid.reshape(-1), overflow=overflow,
+        )
     r_sorted, r_rid_sorted = sort_kv_unstable(_sort_key(inner), inner.rid)
     sk = _sort_key(outer)
     lo = jnp.searchsorted(r_sorted, sk, side="left", method="sort")
     hi = jnp.searchsorted(r_sorted, sk, side="right", method="sort")
     n_outer = sk.shape[0]
-    k = jnp.arange(cap, dtype=jnp.int32)[None, :]              # [1, cap]
     idx = lo[:, None] + k                                      # [n_outer, cap]
     valid = idx < hi[:, None]
     idx = jnp.minimum(idx, r_sorted.shape[0] - 1)

@@ -187,30 +187,66 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
         interpret=(impl == "pallas_interpret"))
 
 
+def _rotate_pid(lo: jnp.ndarray, fanout_bits: int) -> jnp.ndarray:
+    """Rotate the low key lane right by ``fanout_bits`` so the partition id
+    occupies the top bits: sorting by (lo_rot, hi) groups by partition first,
+    then by (key remainder, hi) — equal (hi, lo) keys stay adjacent, which is
+    all the weight scan needs (run equality, not numeric order)."""
+    if not fanout_bits:
+        return lo
+    f = jnp.uint32(fanout_bits)
+    return (lo << jnp.uint32(32 - fanout_bits)) | (lo >> f)
+
+
 def merge_count_wide_per_partition(
     r_lo: jnp.ndarray, r_hi: jnp.ndarray,
     s_lo: jnp.ndarray, s_hi: jnp.ndarray,
     fanout_bits: int,
+    impl: str | None = None,
 ) -> jnp.ndarray:
     """64-bit-key match counting without 64-bit arithmetic.
 
     TPU int64 is limited/slow (SURVEY.md §7.4 item 3), so wide keys ride as
     two uint32 lanes and the combined sort is a three-key lexicographic
-    ``lax.sort((hi, lo, tag))`` — the tag key keeps every equal-key run's R
-    tuples ahead of its S tuples, exactly what the 31-bit packing achieves in
-    the single-lane path.  The weight scheme is the module's usual
-    cumsum/cummax pass with run boundaries on (hi, lo).  No jax x64 needed.
+    ``lax.sort`` — the tag key keeps every equal-key run's R tuples ahead of
+    its S tuples, exactly what the 31-bit packing achieves in the single-lane
+    path.  The weight scheme is the module's usual cumsum/cummax pass with
+    run boundaries on (hi, lo).  No jax x64 needed.
+
+    ``impl`` as in :func:`merge_count_per_partition`: the TPU path sorts by
+    (pid-rotated lo, hi, tag) and fuses the scan + per-partition histogram
+    into one Pallas pass (merge_scan_partitions_wide); the XLA fallback
+    sorts (hi, lo, tag) and bincounts the weights.
 
     Pad sentinels sit in BOTH lanes (make_padding wide=True), and R/S pads
-    differ in the hi lane, so padding contributes zero weight.
+    differ in the hi lane, so padding contributes zero weight on either path.
     """
-    one = jnp.uint32(1)
+    if impl is None:
+        from tpu_radix_join.ops.pallas.merge_scan import pallas_available
+        impl = "pallas" if (pallas_available()
+                            and (1 << fanout_bits) <= 128) else "xla"
     hi = jnp.concatenate([r_hi, s_hi])
     lo = jnp.concatenate([r_lo, s_lo])
     tag = jnp.concatenate([
         jnp.zeros(r_lo.shape, jnp.uint32), jnp.ones(s_lo.shape, jnp.uint32)])
-    hi, lo, tag = _sort_lex_unstable(hi, lo, tag, num_keys=3)
+    if impl != "xla":
+        from tpu_radix_join.ops.pallas.merge_scan import (
+            TILE, merge_scan_partitions_wide)
+        lo_rot, hi, tag = _sort_lex_unstable(
+            _rotate_pid(lo, fanout_bits), hi, tag, num_keys=3)
+        pad = (-lo_rot.shape[0]) % TILE
+        if pad:
+            # the wide S pad's image (all-ones lanes, tag 1) is the
+            # lexicographic maximum, so post-sort padding keeps sortedness
+            ones = jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)
+            lo_rot = jnp.concatenate([lo_rot, ones])
+            hi = jnp.concatenate([hi, ones])
+            tag = jnp.concatenate([tag, jnp.ones((pad,), jnp.uint32)])
+        return merge_scan_partitions_wide(
+            lo_rot, hi, tag, num_partitions=1 << fanout_bits,
+            interpret=(impl == "pallas_interpret"))
 
+    hi, lo, tag = _sort_lex_unstable(hi, lo, tag, num_keys=3)
     prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), hi[:-1]])
     prev_lo = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), lo[:-1]])
     # position 0 is always a run start: (prev_hi, prev_lo) = the S pad pair,

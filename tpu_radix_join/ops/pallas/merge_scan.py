@@ -221,6 +221,117 @@ def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
     return jax.lax.bitcast_convert_type(out, jnp.uint32)
 
 
+def _kernel_partitions_wide(lo_ref, hi_ref, tag_ref, out_ref,
+                            c_r_ref, base_ref, prev_lo_ref, prev_hi_ref,
+                            *, num_partitions: int, pid_shift: int):
+    """Wide-key (hi/lo lane) variant of :func:`_kernel_partitions`.
+
+    Input is the three-lane partition-major sort order (lo_rot, hi, tag)
+    where ``lo_rot`` is the low key lane rotated so the pid sits in its top
+    bits (merge_count._rotate_pid).  Both 32-bit key lanes use all 32 bits,
+    and Mosaic legalizes neither unsigned max nor uint->int converts of
+    values >= 2^31, so comparisons ride an order-preserving bitcast:
+    ``x ^ 0x8000_0000`` reinterpreted as int32 (run equality and max-based
+    carry extraction are both preserved).  A tile's first element losing its
+    run_start against the initial carry is harmless: its run base is 0,
+    exactly what the carry init encodes.
+    """
+    t = pl.program_id(0)
+    int32_min = jnp.int32(-2147483648)
+
+    @pl.when(t == 0)
+    def _init():
+        for p in range(num_partitions):
+            out_ref[p] = jnp.int32(0)
+        c_r_ref[0] = jnp.int32(0)
+        base_ref[0] = jnp.int32(0)
+        prev_lo_ref[0] = int32_min
+        prev_hi_ref[0] = int32_min
+
+    flip = jnp.uint32(0x80000000)
+    lo = jax.lax.bitcast_convert_type(lo_ref[:] ^ flip, jnp.int32)
+    hi = jax.lax.bitcast_convert_type(hi_ref[:] ^ flip, jnp.int32)
+    is_s = tag_ref[:].astype(jnp.int32)
+    is_r = 1 - is_s
+
+    carry_c_r = c_r_ref[0]
+    carry_base = base_ref[0]
+    c_r = _tile_cumsum(is_r) + carry_c_r
+
+    lane_idx = jax.lax.broadcasted_iota(jnp.int32, lo.shape, 1)
+    row_idx = jax.lax.broadcasted_iota(jnp.int32, lo.shape, 0)
+
+    def shift_prev(x, carry):
+        rl = pltpu.roll(x, 1, axis=1)
+        prev = jnp.where(lane_idx == 0, pltpu.roll(rl, 1, axis=0), rl)
+        return jnp.where((lane_idx == 0) & (row_idx == 0), carry, prev)
+
+    run_start = ((lo != shift_prev(lo, prev_lo_ref[0]))
+                 | (hi != shift_prev(hi, prev_hi_ref[0])))
+    base_at_start = jnp.where(run_start, c_r - is_r, 0)
+    base_run = jnp.maximum(_tile_cummax(base_at_start), carry_base)
+    weight = is_s * (c_r - base_run)
+
+    if num_partitions == 1:
+        out_ref[0] = out_ref[0] + jnp.sum(jnp.sum(weight, axis=0))
+    else:
+        pid = (lo_ref[:] >> jnp.uint32(pid_shift)).astype(jnp.int32)
+        pid_min = jnp.min(pid)
+        pid_max = jnp.max(pid)
+        for p in range(num_partitions):
+            @pl.when((pid_min <= p) & (p <= pid_max))
+            def _acc(p=p):
+                c = jnp.sum(jnp.sum(jnp.where(pid == p, weight, 0), axis=0))
+                out_ref[p] = out_ref[p] + c
+
+    c_r_ref[0] = carry_c_r + jnp.sum(is_r)
+    base_ref[0] = jnp.max(base_run)
+    # last flat element of (lo, hi): lo is sorted so last lo == max; the
+    # last hi is the max over the final lo run (hi sorted within equal lo)
+    last_lo = jnp.max(lo)
+    c_r_dummy = jnp.where(lo == last_lo, hi, int32_min)
+    prev_lo_ref[0] = last_lo
+    prev_hi_ref[0] = jnp.max(c_r_dummy)
+
+
+@functools.partial(jax.jit, static_argnames=("num_partitions", "interpret"))
+def merge_scan_partitions_wide(lo_rot_sorted: jnp.ndarray,
+                               hi_sorted: jnp.ndarray,
+                               tag_sorted: jnp.ndarray, *,
+                               num_partitions: int,
+                               interpret: bool = False) -> jnp.ndarray:
+    """Per-partition match counts for 64-bit keys in one pass over the
+    three-lane partition-major sort order (see merge_count's wide Pallas
+    path).  Lengths must be a tile multiple (pad post-sort with the all-ones
+    triple (0xFFFFFFFF, 0xFFFFFFFF, 1) — the wide S pad image, lexicographic
+    maximum, zero weight)."""
+    n = lo_rot_sorted.shape[0]
+    if n % TILE:
+        raise ValueError(f"length {n} must be a multiple of {TILE}")
+    if num_partitions & (num_partitions - 1):
+        raise ValueError("num_partitions must be a power of two")
+    num_tiles = n // TILE
+    pid_shift = 32 - (num_partitions.bit_length() - 1)
+    kernel = functools.partial(_kernel_partitions_wide,
+                               num_partitions=num_partitions,
+                               pid_shift=pid_shift)
+    spec = pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
+                        memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(num_tiles,),
+        in_specs=[spec, spec, spec],
+        out_specs=pl.BlockSpec((num_partitions,), lambda t: (0,),
+                               memory_space=pltpu.SMEM),
+        out_shape=out_struct((num_partitions,), jnp.int32, lo_rot_sorted),
+        scratch_shapes=[pltpu.SMEM((1,), jnp.int32) for _ in range(4)],
+        interpret=interpret,
+    )(lo_rot_sorted.reshape(num_tiles * ROWS, LANES),
+      hi_sorted.reshape(num_tiles * ROWS, LANES),
+      tag_sorted.reshape(num_tiles * ROWS, LANES))
+    return jax.lax.bitcast_convert_type(out, jnp.uint32)
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def merge_scan_chunks(packed_sorted: jnp.ndarray,
                       interpret: bool = False) -> jnp.ndarray:
