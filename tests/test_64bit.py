@@ -186,7 +186,9 @@ def test_materialize_64bit():
 
 
 def _rel64(keys64):
-    """Adapter: join_materialize takes Relations; wrap raw arrays."""
+    """Adapter: join_materialize takes Relations; wrap raw uint64 arrays
+    following the wide shard_np contract — (key_lo, key_hi, rid) 3-tuples
+    (relation.Relation.shard_np)."""
     class _Fixed:
         def __init__(self, k):
             self.k = k
@@ -195,8 +197,92 @@ def _rel64(keys64):
             n = len(self.k) // 4
             sl = self.k[i * n:(i + 1) * n]
             return ((sl & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+                    (sl >> np.uint64(32)).astype(np.uint32),
                     np.arange(i * n, (i + 1) * n, dtype=np.uint32))
     return _Fixed(keys64)
+
+
+def test_key_width_mismatch_raises():
+    """A 64-bit config must refuse lo-lane-only inputs (and vice versa) —
+    silent truncation was round 2's worst bug."""
+    rng = np.random.default_rng(5)
+    k64 = rng.integers(0, 1 << 40, 256, dtype=np.uint64)
+    wide = _batch64(k64)
+    narrow = TupleBatch(key=wide.key, rid=wide.rid)
+    eng64 = HashJoin(JoinConfig(num_nodes=4, network_fanout_bits=4, key_bits=64))
+    eng32 = HashJoin(JoinConfig(num_nodes=4, network_fanout_bits=4))
+    with pytest.raises(ValueError, match="key_hi"):
+        eng64.join_arrays(narrow, narrow)
+    with pytest.raises(ValueError, match="key_hi"):
+        eng32.join_arrays(wide, wide)
+    with pytest.raises(ValueError, match="key_hi"):
+        eng64.join_materialize_arrays(wide, narrow)
+    # Relation-level mismatch dies in _place before any device work
+    from tpu_radix_join.data.relation import Relation
+    rel32 = Relation(1 << 10, 4, "unique", seed=1)
+    with pytest.raises(ValueError, match="hi key lane"):
+        eng64.join(rel32, rel32)
+
+
+def test_relation_wide_generation():
+    """Relation(key_bits=64) emits hi/lo lanes: host/device identical, all
+    keys above 2**62 (hi lane in [2**30, 2**31)), lo lane = the 32-bit
+    logical key so every oracle carries over."""
+    from tpu_radix_join.data.relation import Relation
+    rel = Relation(1 << 12, 2, "unique", seed=9, key_bits=64)
+    lo0, hi0, rid0 = rel.shard_np(0)
+    assert (hi0 >= (1 << 30)).all() and (hi0 < (1 << 31)).all()
+    dev = rel.shard(0)
+    assert dev.key_hi is not None
+    np.testing.assert_array_equal(np.asarray(dev.key), lo0)
+    np.testing.assert_array_equal(np.asarray(dev.key_hi), hi0)
+    np.testing.assert_array_equal(np.asarray(dev.rid), rid0)
+    # hi lanes vary (a real 64-bit domain, not one constant plane)
+    assert len(np.unique(hi0)) > 1000
+
+
+def test_relation_driven_join_64bit():
+    """The full driver path — Relation -> _place -> join()/join_materialize()
+    — on 64-bit keys returns exact counts (VERDICT r2 next #1 done-check)."""
+    from tpu_radix_join.data.relation import Relation
+    n = 1 << 12
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=4, key_bits=64)
+    inner = Relation(n, 4, "unique", seed=21, key_bits=64)
+    outer = Relation(n, 4, "unique", seed=22, key_bits=64)
+    eng = HashJoin(cfg)
+    res = eng.join(inner, outer)
+    assert res.ok, res.diagnostics
+    assert res.matches == inner.expected_matches(outer) == n
+    mat = eng.join_materialize(inner, outer)
+    assert mat.ok, mat.diagnostics
+    assert mat.matches == n
+    # every materialized pair is a true 64-bit match
+    r_lo, r_hi, _ = inner.shard_np(0)
+    for i in range(1, 4):
+        lo_i, hi_i, _ = inner.shard_np(i)
+        r_lo, r_hi = np.concatenate([r_lo, lo_i]), np.concatenate([r_hi, hi_i])
+    s_lo, s_hi = [], []
+    for i in range(4):
+        lo_i, hi_i, _ = outer.shard_np(i)
+        s_lo.append(lo_i), s_hi.append(hi_i)
+    s_lo, s_hi = np.concatenate(s_lo), np.concatenate(s_hi)
+    r64 = (r_hi.astype(np.uint64) << np.uint64(32)) | r_lo
+    s64 = (s_hi.astype(np.uint64) << np.uint64(32)) | s_lo
+    assert np.array_equal(np.sort(r64[mat.r_rid]), np.sort(s64[mat.s_rid]))
+
+
+def test_streaming_wide_chunks():
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.data.streaming import stream_chunks
+    rel = Relation(1 << 10, 1, "unique", seed=13, key_bits=64)
+    lo, hi, _ = rel.shard_np(0)
+    got_lo, got_hi = [], []
+    for chunk in stream_chunks(rel, 0, 300):
+        assert chunk.key_hi is not None
+        got_lo.append(np.asarray(chunk.key))
+        got_hi.append(np.asarray(chunk.key_hi))
+    np.testing.assert_array_equal(np.concatenate(got_lo), lo)
+    np.testing.assert_array_equal(np.concatenate(got_hi), hi)
 
 
 def test_pipeline_64bit_no_x64():

@@ -98,6 +98,43 @@ def test_hot_split_wide_keys():
     assert (pc[:, 3] > 0).all()       # hot work on every device
 
 
+def test_hot_split_congruent_rids_still_balance():
+    """Adversarial rid pattern (VERDICT r2 next #6): every hot-S tuple's rid
+    is ≡ 0 (mod n).  Raw ``rid % n`` would pile the whole hot partition back
+    on device 0; the hashed spread must keep the same balance bound the dense
+    -rid test uses."""
+    n, size = 8, 1 << 15
+    rk = np.arange(size, dtype=np.uint32)
+    # hot key 3 occupies every n-th slot -> hot rids are 0, n, 2n, ...
+    sk = np.arange(size, dtype=np.uint32)
+    sk[::n] = 3
+    r, s = _batch(rk), _batch(sk)
+    # hot key is 1/n of S (s[3] ~ 2.5x the mean partition weight)
+    cfg = JoinConfig(num_nodes=n, skew_threshold=2.0, max_retries=1)
+    res = HashJoin(cfg).join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    # every S slot holds some key < size, and R is dense unique over [0, size)
+    # -> every S tuple matches exactly once
+    assert res.matches == size
+    pc = res.partition_counts.reshape(n, 32)
+    hot = pc[:, 3].astype(np.int64)
+    assert hot.min() > 0
+    assert hot.max() <= 1.5 * hot.mean()
+
+
+def test_build_hot_partition_not_split():
+    """A partition hot purely on the BUILD side must not be split: replicating
+    the largest R slice n-fold is worse than single ownership (ADVICE r2)."""
+    r = np.full(32, 100, np.uint64)
+    s = np.full(32, 100, np.uint64)
+    r[5] = 50000
+    hot = skew.detect_hot_partitions(r, s, 4.0)
+    assert not hot.any()
+    # but the same weight on the probe side does split
+    hot2 = skew.detect_hot_partitions(s, r, 4.0)
+    assert hot2[5] and hot2.sum() == 1
+
+
 def test_zipf_skew_split_end_to_end():
     n, size = 8, 1 << 14
     cfg = JoinConfig(num_nodes=n, skew_threshold=3.0,

@@ -76,9 +76,10 @@ class JoinConfig:
     # --- skew handling ---------------------------------------------------------
     # Probe-level hot-partition splitting (operators/skew.py; the reference's
     # dormant SD::OPT skew machinery, kernels_optimized.cu:301-344,864-943):
-    # partitions whose global (R+S) weight exceeds skew_threshold x the mean
-    # are split — inner side replicated via all_gather, outer side sharded
-    # round-robin — instead of owned by one node.  None disables.  Requires
+    # partitions whose global OUTER weight exceeds skew_threshold x the mean
+    # total weight (and whose inner side is cheap enough to replicate) are
+    # split — inner side replicated via all_gather, outer side spread by a
+    # rid hash — instead of owned by one node.  None disables.  Requires
     # the sort probe discipline and network fanout <= 5 (the hot set is a
     # uint32 bit mask).
     skew_threshold: Optional[float] = None

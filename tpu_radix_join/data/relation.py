@@ -42,6 +42,43 @@ from tpu_radix_join.native.build import load as _load_native
 _FEISTEL_ROUNDS = 6
 _ZIPF_TABLE_MAX = 65536
 
+# 64-bit key spread (key_bits=64): the upper lane is a fixed mix of the
+# 32-bit logical key, shared by every relation (NOT seeded) so equal logical
+# keys always map to equal wide keys — every closed-form oracle carries over
+# unchanged, and the hi lane is a deterministic function of the lo lane, so
+# the streaming loader can derive it per chunk.  The mix lands in
+# [2**30, 2**31): every generated wide key exceeds 2**62 (a genuinely >32-bit
+# domain, like the reference's uint64 keys, Tuple.h:19-20) and the sentinel
+# lane (tuples.py: key_hi for wide batches) can never collide with the
+# 0xFFFFFFFE/0xFFFFFFFF padding sentinels.  Injectivity is by the lo lane:
+# the logical-key generators already guarantee it for the "unique" kind.
+_HI_LANE_LOW = np.uint32(0x40000000)
+_HI_LANE_MASK = np.uint32(0x3FFFFFFF)
+
+
+def key_hi_lane_np(key: np.ndarray) -> np.ndarray:
+    """uint32 hi lane for wide keys — numpy twin of :func:`key_hi_lane`."""
+    x = key.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(0x7FEB352D)
+        x = x ^ (x >> np.uint32(15))
+        x = x * np.uint32(0x846CA68B)
+        x = x ^ (x >> np.uint32(16))
+    return (x & _HI_LANE_MASK) | _HI_LANE_LOW
+
+
+@jax.jit
+def key_hi_lane(key: jnp.ndarray) -> jnp.ndarray:
+    """Device twin of :func:`key_hi_lane_np` (bit-identical)."""
+    x = key.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return (x & jnp.uint32(_HI_LANE_MASK)) | jnp.uint32(_HI_LANE_LOW)
+
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:
     """Vectorized splitmix64 — must match datagen.cc exactly."""
@@ -171,12 +208,17 @@ class Relation:
             raise ValueError("modulo kind requires modulo=")
         if kind == "zipf" and (zipf_theta is None or zipf_theta <= 0):
             raise ValueError("zipf kind requires zipf_theta= > 0")
+        if key_bits not in (32, 64):
+            raise ValueError("key_bits must be 32 or 64")
         # Deliberate contract: benchmark relations stay within the merge-probe
         # key range so every probe discipline accepts them interchangeably.
         if key_bits == 32 and global_size > (1 << 31) - 2:
             raise ValueError(
                 "32-bit keys cap global_size at 2**31 - 2 (31-bit merge-count "
                 "packing + sentinel headroom); use key_bits=64 beyond that")
+        if key_bits == 64 and global_size > (1 << 32) - 1:
+            raise ValueError(
+                "global_size caps at 2**32 - 1 (dense uint32 rids)")
         self.global_size = int(global_size)
         self.num_nodes = int(num_nodes)
         self.kind = kind
@@ -261,10 +303,19 @@ class Relation:
                               self.seed)
         return key, rid
 
-    def shard_np(self, node: int, num_threads: int = 0) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys, rids) as numpy uint32 arrays for one node's shard."""
-        return self.fill_np(node * self.local_size, self.local_size,
-                            num_threads)
+    def shard_np(self, node: int, num_threads: int = 0) -> Tuple[np.ndarray, ...]:
+        """One node's shard as numpy uint32 arrays.
+
+        Contract (the driver's ``HashJoin._place`` consumes this): a 2-tuple
+        ``(keys, rids)`` when ``key_bits == 32``; a 3-tuple
+        ``(keys_lo, keys_hi, rids)`` when ``key_bits == 64`` — the wide analog
+        of the reference's uint64 keys (Tuple.h:19-20) as two uint32 lanes.
+        """
+        key, rid = self.fill_np(node * self.local_size, self.local_size,
+                                num_threads)
+        if self.key_bits == 64:
+            return key, key_hi_lane_np(key), rid
+        return key, rid
 
     # ---------------------------------------------------------------- device
     def shard(self, node: int) -> TupleBatch:
@@ -274,9 +325,11 @@ class Relation:
         rid = jnp.arange(lo, lo + self.local_size, dtype=jnp.uint32)
         if self.kind == "unique":
             key = unique_keys_device(lo, self.local_size, self.global_size, self.seed)
-            return TupleBatch(key=key, rid=rid)
-        key_np, rid_np = self.shard_np(node)
-        return TupleBatch(key=jnp.asarray(key_np), rid=jnp.asarray(rid_np))
+        else:
+            key_np, _ = self.fill_np(lo, self.local_size)
+            key = jnp.asarray(key_np)
+        hi = key_hi_lane(key) if self.key_bits == 64 else None
+        return TupleBatch(key=key, rid=rid, key_hi=hi)
 
     # ---------------------------------------------------------------- oracle
     def expected_matches(self, outer: "Relation") -> Optional[int]:

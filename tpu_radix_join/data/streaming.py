@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.relation import Relation, key_hi_lane
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.memory.pool import Pool
 
@@ -80,8 +80,12 @@ def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
             # is independent of the buffer before fill(i+2) rewrites it.
             key = jnp.array(key_buf[:n], copy=True)
             rid = jnp.array(rid_buf[:n], copy=True)
+            # wide relations: the hi lane is a pure on-device function of the
+            # lo lane (relation.key_hi_lane), so the wire/pool format stays
+            # two uint32 buffers regardless of key width
+            hi = key_hi_lane(key) if rel.key_bits == 64 else None
             jax.block_until_ready((key, rid))
-            yield TupleBatch(key=key, rid=rid)
+            yield TupleBatch(key=key, rid=rid, key_hi=hi)
     finally:
         ex.shutdown(wait=True)
         if own_pool:

@@ -40,12 +40,21 @@ MAX_SKEW_PARTITIONS = 32
 
 def detect_hot_partitions(r_ghist: np.ndarray, s_ghist: np.ndarray,
                           threshold: float) -> np.ndarray:
-    """bool [P]: partitions whose combined (R+S) global weight exceeds
-    ``threshold`` x the mean partition weight (skew_detect's
+    """bool [P]: partitions worth splitting (skew_detect's
     blocks-per-partition criterion, kernels_optimized.cu:301-311, reduced to
-    a binary split/don't-split decision)."""
-    w = r_ghist.astype(np.float64) + s_ghist.astype(np.float64)
-    return w > threshold * w.mean()
+    a binary split/don't-split decision).
+
+    The split replicates the partition's entire R to every device and spreads
+    its S, so it pays off exactly when the *probe* side dominates: detection
+    requires (a) the S weight alone to exceed ``threshold`` x the mean total
+    partition weight, and (b) the R side not to be hot itself (its weight
+    within ``threshold`` x the mean R weight) — a build-heavy partition would
+    cost n-fold memory/ICI to replicate precisely where R is largest, worse
+    than leaving it owned by one node (ADVICE r2)."""
+    r = r_ghist.astype(np.float64)
+    s = s_ghist.astype(np.float64)
+    w = r + s
+    return (s > threshold * w.mean()) & (r <= threshold * max(r.mean(), 1.0))
 
 
 def hot_mask_bits(hot: np.ndarray) -> int:
@@ -63,11 +72,22 @@ def is_hot(pid: jnp.ndarray, hot_bits: int) -> jnp.ndarray:
 
 
 def spread_destinations(rid: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
-    """Destination for hot outer tuples: round-robin by rid — dense rids give
-    an exactly balanced shard, arbitrary rids a hash-balanced one (the analog
-    of generate_block_mapping distributing a hot partition's chunks over
-    blocks, kernels_optimized.cu:321-344)."""
-    return rid % jnp.uint32(num_nodes)
+    """Destination for hot outer tuples: a cheap integer mix of the rid,
+    modulo the mesh size (the analog of generate_block_mapping distributing a
+    hot partition's chunks over blocks, kernels_optimized.cu:321-344).
+
+    The mix (splitmix32-style xorshift-multiply finalizer) matters: raw
+    ``rid % n`` puts every tuple of a pre-filtered/strided outer side whose
+    rids are congruent mod n back on ONE device — silently recreating the
+    skew the split exists to fix.  The sizing program and the shuffle both
+    call this, so measured capacities stay exact for any rid pattern."""
+    x = rid.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x % jnp.uint32(num_nodes)
 
 
 def mask_hot(hist: jnp.ndarray, hot_bits: int) -> jnp.ndarray:
