@@ -69,12 +69,80 @@ def test_join_populates_registry():
     s = Relation(size, 4, "unique", seed=2)
     res = HashJoin(cfg, measurements=m).join(r, s)
     assert res.matches == size
-    for key in (M.JTOTAL, M.SWINALLOC, M.JPROC):
+    for key in (M.JTOTAL, M.SWINALLOC, M.JPROC, M.JHIST):
         assert m.times_us[key] > 0
+    # fused pipeline: the JMPI/JPROC split needs measure_phases
+    assert M.JMPI not in m.times_us
     assert m.counters[M.RESULTS] == size
     assert m.counters[M.MWINPUTCNT] == 8
     assert m.counters[M.JRATE] > 0
     assert m.counters[M.JPROCRATE] >= m.counters[M.JRATE]
+
+
+def test_measure_phases_records_jmpi_and_jproc():
+    """config.measure_phases runs shuffle and probe as two programs; the
+    .perf registry must carry all four headline phase columns
+    (Measurements.cpp:136-141) with nonzero values, and the result must be
+    identical to the fused pipeline's."""
+    size = 1 << 12
+    r = Relation(size, 4, "unique", seed=1)
+    s = Relation(size, 4, "unique", seed=2)
+    m = Measurements(num_nodes=4)
+    cfg = JoinConfig(num_nodes=4, measure_phases=True)
+    res = HashJoin(cfg, measurements=m).join(r, s)
+    assert res.ok and res.matches == size
+    for key in (M.JTOTAL, M.JHIST, M.JMPI, M.JPROC):
+        assert m.times_us[key] > 0, key
+    fused = HashJoin(JoinConfig(num_nodes=4)).join(r, s)
+    assert fused.matches == res.matches
+    import numpy as np
+    np.testing.assert_array_equal(fused.partition_counts,
+                                  res.partition_counts)
+
+
+def test_measure_phases_skew_and_retry_mwinwait():
+    """Phase-split execution composes with the skew split, and a retried
+    (undersized) attempt's time lands in MWINWAIT, not JPROC."""
+    import numpy as np
+    import jax.numpy as jnp
+    from tpu_radix_join.data.tuples import TupleBatch
+    n, size = 8, 1 << 14
+    half = size // 2
+    rk = np.arange(size, dtype=np.uint32)
+    sk = np.concatenate([np.full(half, 3, np.uint32),
+                         np.arange(half, dtype=np.uint32)])
+    r = TupleBatch(key=jnp.asarray(rk),
+                   rid=jnp.arange(size, dtype=jnp.uint32))
+    s = TupleBatch(key=jnp.asarray(sk),
+                   rid=jnp.arange(size, dtype=jnp.uint32))
+    m = Measurements(num_nodes=n)
+    cfg = JoinConfig(num_nodes=n, skew_threshold=4.0, measure_phases=True,
+                     max_retries=1)
+    res = HashJoin(cfg, measurements=m).join_arrays(r, s)
+    assert res.ok and res.matches == size
+    assert m.times_us[M.JMPI] > 0 and m.times_us[M.JPROC] > 0
+    # retry accounting: force a shortfall via static undersized windows
+    m2 = Measurements(num_nodes=4)
+    cfg2 = JoinConfig(num_nodes=4, window_sizing="static",
+                      allocation_factor=1.0, max_retries=3)
+    zr = TupleBatch(key=jnp.zeros(1 << 10, jnp.uint32),   # all partition 0
+                    rid=jnp.arange(1 << 10, dtype=jnp.uint32))
+    su = TupleBatch(key=jnp.arange(1 << 10, dtype=jnp.uint32),
+                    rid=jnp.arange(1 << 10, dtype=jnp.uint32))
+    res2 = HashJoin(cfg2, measurements=m2).join_arrays(zr, su)
+    assert res2.ok
+    assert m2.counters["RETRIES"] >= 1
+    assert m2.times_us[M.MWINWAIT] > 0
+    assert m2.times_us[M.JPROC] > 0
+
+
+def test_load_skips_stray_perf_files(tmp_path):
+    m = Measurements(node_id=0)
+    m.times_us[M.JTOTAL] = 5.0
+    m.store(str(tmp_path))
+    (tmp_path / "notes.perf").write_text("not a rank file\n")
+    loaded = Measurements.load(str(tmp_path))
+    assert len(loaded) == 1 and loaded[0].node_id == 0
 
 
 def test_profiler_trace_smoke(tmp_path):

@@ -86,6 +86,11 @@ class JoinConfig:
 
     # --- instrumentation -------------------------------------------------------
     debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
+    # Phase-split timing (Measurements.cpp:139-141 JMPI/JPROC columns): run
+    # the shuffle and the local probe as two programs so host timers see each
+    # phase, instead of one fused program (which XLA may overlap/fuse across
+    # the phase boundary — faster, but host-opaque).  Costs the fusion.
+    measure_phases: bool = False
 
     def __post_init__(self):
         if self.network_fanout_bits < 0 or self.local_fanout_bits < 0:

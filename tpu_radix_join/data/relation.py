@@ -38,6 +38,7 @@ import numpy as np
 
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.native.build import load as _load_native
+from tpu_radix_join.utils.hashing import mix32, mix32_np
 
 _FEISTEL_ROUNDS = 6
 _ZIPF_TABLE_MAX = 65536
@@ -58,26 +59,14 @@ _HI_LANE_MASK = np.uint32(0x3FFFFFFF)
 
 def key_hi_lane_np(key: np.ndarray) -> np.ndarray:
     """uint32 hi lane for wide keys — numpy twin of :func:`key_hi_lane`."""
-    x = key.astype(np.uint32)
-    with np.errstate(over="ignore"):
-        x = x ^ (x >> np.uint32(16))
-        x = x * np.uint32(0x7FEB352D)
-        x = x ^ (x >> np.uint32(15))
-        x = x * np.uint32(0x846CA68B)
-        x = x ^ (x >> np.uint32(16))
-    return (x & _HI_LANE_MASK) | _HI_LANE_LOW
+    return (mix32_np(key) & _HI_LANE_MASK) | _HI_LANE_LOW
 
 
 @jax.jit
 def key_hi_lane(key: jnp.ndarray) -> jnp.ndarray:
     """Device twin of :func:`key_hi_lane_np` (bit-identical)."""
-    x = key.astype(jnp.uint32)
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    x = x ^ (x >> 16)
-    return (x & jnp.uint32(_HI_LANE_MASK)) | jnp.uint32(_HI_LANE_LOW)
+    return ((mix32(key) & jnp.uint32(_HI_LANE_MASK))
+            | jnp.uint32(_HI_LANE_LOW))
 
 
 def _splitmix64(x: np.ndarray) -> np.ndarray:

@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-checks", action="store_true",
                    help="per-partition conservation invariants "
                         "(JOIN_ASSERT analog; extra passes)")
+    p.add_argument("--measure-phases", action="store_true",
+                   help="run shuffle and probe as separate programs so "
+                        ".perf carries JMPI and JPROC columns (costs the "
+                        "cross-phase fusion)")
     p.add_argument("--outer-kind", choices=["unique", "modulo", "zipf"],
                    default="unique")
     p.add_argument("--modulo", type=int, default=None)
@@ -91,6 +95,7 @@ def main(argv=None) -> int:
         max_retries=args.max_retries,
         skew_threshold=args.skew_threshold,
         debug_checks=args.debug_checks,
+        measure_phases=args.measure_phases,
     )
     global_size = args.tuples_per_node * nodes
     inner = Relation(global_size, nodes, "unique", seed=args.seed)

@@ -35,6 +35,7 @@ from tpu_radix_join.data.tuples import (
 from tpu_radix_join.histograms import (
     compute_global_histogram,
     compute_local_histogram,
+    compute_offsets,
     compute_partition_assignment,
 )
 from tpu_radix_join.ops.build_probe import (
@@ -164,6 +165,20 @@ class HashJoin:
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=(spec, spec, P(), P(), spec)))
 
+    def _keys_in_contract(self, r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
+        """Input contract check (traced): real keys must stay below the
+        padding sentinels (tuples.py) — and below the 31-bit merge-count
+        packing limit when the narrow sort-merge probe is the branch in use.
+        Violations flip ``ok`` rather than silently overcounting against
+        padding slots."""
+        cfg = self.config
+        sort_probe = (not cfg.two_level and cfg.probe_algorithm != "bucket"
+                      and not cfg.chunk_size)
+        uses_merge = r.key_hi is None and sort_probe
+        key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
+        return (jnp.max(_sentinel_lane(r)) < key_cap) & (
+            jnp.max(_sentinel_lane(s)) < key_cap)
+
     def _single_node_sort_probe(self) -> bool:
         """True when the pipeline takes the n==1 specialization (no shuffle,
         no windows): the sizing pre-pass would compute capacities nothing
@@ -196,9 +211,7 @@ class HashJoin:
         if cfg.window_sizing == "static":
             return (cfg.shuffle_block_capacity(r.size // n),
                     cfg.shuffle_block_capacity(s.size // n), None)
-        if ("hist", 0) not in self._compiled:
-            self._compiled[("hist", 0)] = self._histogram_fn()
-        r_demand, s_demand, r_gh, s_gh, _ = self._compiled[("hist", 0)](r, s)
+        r_demand, s_demand, r_gh, s_gh, _ = self._run_hist(r, s, 0)
 
         def cap(demand):
             worst = max(1, int(np.asarray(demand).max()))
@@ -210,14 +223,38 @@ class HashJoin:
                 np.asarray(r_gh), np.asarray(s_gh), cfg.skew_threshold)
             if hot.any():
                 hot_bits = skew.hot_mask_bits(hot)
-                if ("hist", hot_bits) not in self._compiled:
-                    self._compiled[("hist", hot_bits)] = self._histogram_fn(
-                        hot_bits)
-                r_demand, s_demand, _, _, hot_counts = self._compiled[
-                    ("hist", hot_bits)](r, s)
+                r_demand, s_demand, _, _, hot_counts = self._run_hist(
+                    r, s, hot_bits)
                 skew_plan = (hot_bits, cap(hot_counts))
 
         return cap(r_demand), cap(s_demand), skew_plan
+
+    def _run_hist(self, r: TupleBatch, s: TupleBatch, hot_bits: int):
+        """AOT-compile (JCOMPILE) and execute (JHIST) the sizing program.
+
+        JHIST is the reference's histogram-phase column
+        (Measurements.cpp:139,183-244): here the local+global histogram work
+        runs inside the sizing program, so its execution time — separated
+        from compilation — is the honest analog."""
+        m = self.measurements
+        n = self.config.num_nodes
+        key = ("hist", hot_bits, r.size // n, s.size // n,
+               r.key_hi is None, s.key_hi is None,
+               getattr(r.key, "sharding", None),
+               getattr(s.key, "sharding", None))
+        if key not in self._compiled:
+            if m:
+                m.start("JCOMPILE")
+            self._compiled[key] = self._histogram_fn(
+                hot_bits).lower(r, s).compile()
+            if m:
+                m.stop("JCOMPILE")
+        if m:
+            m.start("JHIST")
+        out = self._compiled[key](r, s)
+        if m:
+            m.stop("JHIST", fence=out)
+        return out
 
     def _pipeline_fn(self, local_size_r: int, local_size_s: int,
                      cap_r: int, cap_s: int, local_slack: int = 1,
@@ -231,17 +268,10 @@ class HashJoin:
         win_s = Window(n, cap_s, ax, "outer")
 
         def body(r: TupleBatch, s: TupleBatch):
-            # Input contract: real keys must stay below the padding sentinels
-            # (tuples.py) — and below the 31-bit merge-count packing limit
-            # when the merge probe is the branch in use.  Violations flip `ok`
-            # rather than silently overcounting against padding slots.
             sort_probe = (not cfg.two_level
                           and cfg.probe_algorithm != "bucket"
                           and not cfg.chunk_size)
-            uses_merge = r.key_hi is None and sort_probe
-            key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
-            keys_ok = (jnp.max(_sentinel_lane(r)) < key_cap) & (
-                jnp.max(_sentinel_lane(s)) < key_cap)
+            keys_ok = self._keys_in_contract(r, s)
 
             if n == 1 and sort_probe:
                 # Single-node specialization: the all_to_all is an identity
@@ -269,49 +299,9 @@ class HashJoin:
                 self._shuffle(r, s, win_r, win_s, skew_plan)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
-            if cfg.two_level or cfg.probe_algorithm == "bucket":
-                nb = cfg.local_partition_count
-                lcap_r = cfg.bucket_capacity(n * cap_r, nb) * local_slack
-                lcap_s = cfg.bucket_capacity(n * cap_s, nb) * local_slack
-                lr = local_partition(rp.batch, rp.valid, fanout,
-                                     cfg.local_fanout_bits, lcap_r, "inner")
-                ls = local_partition(sp.batch, sp.valid, fanout,
-                                     cfg.local_fanout_bits, lcap_s, "outer")
-                # wide keys: hi lanes ride the same blocks; the probe's
-                # three-key batched row sort compares full (hi, lo) pairs
-                counts = probe_count_bucketized(
-                    lr.blocks.key.reshape(nb, lcap_r),
-                    ls.blocks.key.reshape(nb, lcap_s),
-                    None if r.key_hi is None
-                    else lr.blocks.key_hi.reshape(nb, lcap_r),
-                    None if s.key_hi is None
-                    else ls.blocks.key_hi.reshape(nb, lcap_s))
-                local_overflow = lr.overflow + ls.overflow
-            elif cfg.chunk_size:
-                # out-of-core discipline (LD kernels): outer slabs under scan
-                counts = probe_count_chunked(
-                    _as_compressed(rp.batch), _as_compressed(sp.batch),
-                    sp.pid, num_p, cfg.chunk_size)
-                local_overflow = jnp.uint32(0)
-            elif r.key_hi is not None:
-                # 64-bit keys: three-key lexicographic sort-merge on the
-                # hi/lo uint32 lanes — no device int64, no x64 requirement
-                # (SURVEY.md §7.4 item 3)
-                rk_lo, rk_hi = rp.batch.key, rp.batch.key_hi
-                if hot_batch is not None:
-                    rk_lo = jnp.concatenate([rk_lo, hot_batch.key])
-                    rk_hi = jnp.concatenate([rk_hi, hot_batch.key_hi])
-                counts = merge_count_wide_per_partition(
-                    rk_lo, rk_hi, sp.batch.key, sp.batch.key_hi, fanout)
-                local_overflow = jnp.uint32(0)
-            else:
-                rk = rp.batch.key
-                if hot_batch is not None:
-                    # replicated hot build side joins the local probe; its
-                    # padding slots are R sentinels (zero weight)
-                    rk = jnp.concatenate([rk, hot_batch.key])
-                counts = merge_count_per_partition(rk, sp.batch.key, fanout)
-                local_overflow = jnp.uint32(0)
+            counts, local_overflow = self._local_process(
+                rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, hot_batch,
+                cap_r, cap_s, local_slack)
 
             # Failure breakdown, globally reduced (SURVEY.md section 5.3: the
             # reference aborts on any failure; here every mode is counted so
@@ -335,6 +325,172 @@ class HashJoin:
             in_specs=(spec, spec),
             out_specs=(spec, P()),
         ))
+
+    def _shuffle_fn(self, cap_r: int, cap_s: int, skew_plan=None):
+        """Front half of the phase-split pipeline (config.measure_phases):
+        phases 1-4 as their own program so the host timer sees JMPI — the
+        reference's network-partitioning column (Measurements.cpp:140,
+        HashJoin.cpp:91-121) — separately from local processing."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        n = cfg.num_nodes
+        win_r = Window(n, cap_r, ax, "inner")
+        win_s = Window(n, cap_s, ax, "outer")
+
+        def body(r: TupleBatch, s: TupleBatch):
+            keys_ok = self._keys_in_contract(r, s)
+            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
+                self._shuffle(r, s, win_r, win_s, skew_plan)
+            sflags = jnp.stack([
+                jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
+                lost_r.astype(jnp.uint32),
+                lost_s.astype(jnp.uint32),
+                conserve_bad.astype(jnp.uint32),
+                hot_overflow.astype(jnp.uint32),
+            ])
+            out = (rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, sflags)
+            if skew_plan:
+                out = out + (hot_batch,)
+            return out
+
+        spec = P(ax)
+        # hot_batch is value-replicated (all_gather) but shard_map's static
+        # replication check cannot prove it, so it travels "sharded": each
+        # device keeps its identical copy as its shard and the probe program
+        # slices the same copy back out — same bytes per device either way.
+        out_specs = (spec, spec, spec, spec, spec, P())
+        if skew_plan:
+            out_specs = out_specs + (spec,)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=out_specs))
+
+    def _probe_fn(self, cap_r: int, cap_s: int, local_slack: int,
+                  skew_plan=None):
+        """Back half of the phase-split pipeline: local processing on the
+        shuffled buffers, timed by the host as JPROC."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+
+        def run(rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch):
+            counts, local_overflow = self._local_process(
+                rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
+                cap_r, cap_s, local_slack)
+            return counts, jax.lax.psum(local_overflow.astype(jnp.uint32), ax)
+
+        spec = P(ax)
+        if skew_plan:
+            def body(rpb, rpv, spb, spv, spp, hot):
+                return run(rpb, rpv, spb, spv, spp, hot)
+            in_specs = (spec, spec, spec, spec, spec, spec)
+        else:
+            def body(rpb, rpv, spb, spv, spp):
+                return run(rpb, rpv, spb, spv, spp, None)
+            in_specs = (spec, spec, spec, spec, spec)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(spec, P())))
+
+    def _run_split(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
+                   local_slack: int, skew_plan):
+        """Execute one attempt as two programs (shuffle -> probe), recording
+        JMPI and JPROC from the host clock (the reference's per-phase columns;
+        the fused path can only time their sum).  Returns
+        (counts, flags ndarray, dt_mpi_us, dt_proc_us)."""
+        m = self.measurements
+        n = self.config.num_nodes
+        base = (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
+                r.key_hi is None, s.key_hi is None,
+                getattr(r.key, "sharding", None),
+                getattr(s.key, "sharding", None))
+        k_mpi = ("mpi",) + base
+        if k_mpi not in self._compiled:
+            if m:
+                m.start("JCOMPILE")
+            self._compiled[k_mpi] = self._shuffle_fn(
+                cap_r, cap_s, skew_plan).lower(r, s).compile()
+            if m:
+                m.stop("JCOMPILE")
+        if m:
+            m.start("JMPI")
+        shuffled = self._compiled[k_mpi](r, s)
+        dt_mpi = m.stop("JMPI", fence=shuffled) if m else 0.0
+        sflags = np.asarray(shuffled[5])
+        probe_args = tuple(shuffled[:5]) + tuple(shuffled[6:])
+        k_proc = ("proc", local_slack) + base
+        if k_proc not in self._compiled:
+            if m:
+                m.start("JCOMPILE")
+            self._compiled[k_proc] = self._probe_fn(
+                cap_r, cap_s, local_slack, skew_plan
+            ).lower(*probe_args).compile()
+            if m:
+                m.stop("JCOMPILE")
+        if m:
+            m.start("JPROC")
+        counts, local_flag = self._compiled[k_proc](*probe_args)
+        dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
+        flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
+                          int(np.asarray(local_flag)), sflags[4]],
+                         dtype=np.uint32)
+        return counts, flags, dt_mpi, dt_proc
+
+    def _local_process(self, rp_batch: TupleBatch, rp_valid, sp_batch: TupleBatch,
+                       sp_valid, sp_pid, hot_batch, cap_r: int, cap_s: int,
+                       local_slack: int):
+        """Phase 5/6 — local partitioning + build-probe on the received
+        buffers (HashJoin.cpp:131-204).  Traced either inside the fused
+        pipeline body or as its own shard_map program when the driver times
+        JMPI/JPROC separately (``config.measure_phases``).  Returns
+        (per-partition counts, local overflow)."""
+        cfg = self.config
+        n = cfg.num_nodes
+        fanout = cfg.network_fanout_bits
+        num_p = cfg.network_partition_count
+        wide = rp_batch.key_hi is not None
+        if cfg.two_level or cfg.probe_algorithm == "bucket":
+            nb = cfg.local_partition_count
+            lcap_r = cfg.bucket_capacity(n * cap_r, nb) * local_slack
+            lcap_s = cfg.bucket_capacity(n * cap_s, nb) * local_slack
+            lr = local_partition(rp_batch, rp_valid, fanout,
+                                 cfg.local_fanout_bits, lcap_r, "inner")
+            ls = local_partition(sp_batch, sp_valid, fanout,
+                                 cfg.local_fanout_bits, lcap_s, "outer")
+            # wide keys: hi lanes ride the same blocks; the probe's
+            # three-key batched row sort compares full (hi, lo) pairs
+            counts = probe_count_bucketized(
+                lr.blocks.key.reshape(nb, lcap_r),
+                ls.blocks.key.reshape(nb, lcap_s),
+                None if not wide else lr.blocks.key_hi.reshape(nb, lcap_r),
+                None if sp_batch.key_hi is None
+                else ls.blocks.key_hi.reshape(nb, lcap_s))
+            local_overflow = lr.overflow + ls.overflow
+        elif cfg.chunk_size:
+            # out-of-core discipline (LD kernels): outer slabs under scan
+            counts = probe_count_chunked(
+                _as_compressed(rp_batch), _as_compressed(sp_batch),
+                sp_pid, num_p, cfg.chunk_size)
+            local_overflow = jnp.uint32(0)
+        elif wide:
+            # 64-bit keys: three-key lexicographic sort-merge on the
+            # hi/lo uint32 lanes — no device int64, no x64 requirement
+            # (SURVEY.md §7.4 item 3)
+            rk_lo, rk_hi = rp_batch.key, rp_batch.key_hi
+            if hot_batch is not None:
+                rk_lo = jnp.concatenate([rk_lo, hot_batch.key])
+                rk_hi = jnp.concatenate([rk_hi, hot_batch.key_hi])
+            counts = merge_count_wide_per_partition(
+                rk_lo, rk_hi, sp_batch.key, sp_batch.key_hi, fanout)
+            local_overflow = jnp.uint32(0)
+        else:
+            rk = rp_batch.key
+            if hot_batch is not None:
+                # replicated hot build side joins the local probe; its
+                # padding slots are R sentinels (zero weight)
+                rk = jnp.concatenate([rk, hot_batch.key])
+            counts = merge_count_per_partition(rk, sp_batch.key, fanout)
+            local_overflow = jnp.uint32(0)
+        return counts, local_overflow
 
     def _shuffle(self, r: TupleBatch, s: TupleBatch,
                  win_r: Window, win_s: Window, skew_plan=None):
@@ -439,6 +595,16 @@ class HashJoin:
                 want_pp = jnp.where(assignment == me, ghist, 0)
                 row_bad = (got_pp != want_pp) & ~hot_rows
                 pp_bad = pp_bad | (jnp.any(row_bad) & (lost == 0))
+            # OffsetMap invariant (histograms/offset_map.py, the analog of
+            # OffsetMap.cpp:59-93): every rank's exclusive-prefix offset plus
+            # its local count must fit inside the partition's global total —
+            # the disjoint-write-ranges guarantee that lets the reference's
+            # ranks MPI_Put with zero coordination.  A violation means the
+            # histogram collectives disagree (psum vs all_gather), the race
+            # class SURVEY.md §5.2 tracks.
+            for lhist, ghist in ((r_hist, r_ghist), (s_hist, s_ghist)):
+                offs = compute_offsets(lhist, ghist, assignment, ax)
+                pp_bad = pp_bad | jnp.any(offs.relative + lhist > ghist)
             bad_r = bad_r | pp_bad   # same failure class: misrouting
         conserve_bad = jax.lax.psum(
             bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)
@@ -548,10 +714,12 @@ class HashJoin:
         self._check_key_width(r, s)
         m = self.measurements
         # Timer placement mirrors HashJoin.cpp:50-212: JTOTAL spans the whole
-        # join; the histogram/window-sizing program is SWINALLOC (+JHIST,
-        # which it subsumes); the fused shuffle+local program is JMPI+JPROC
-        # (one XLA program — the split is visible in profiler traces, not
-        # host timers).
+        # join; SWINALLOC wraps the sizing pass (whose execution is JHIST and
+        # whose compilation is JCOMPILE, see _run_hist).  By default the
+        # shuffle+local program is fused, so JPROC covers both phases (the
+        # JMPI/JPROC split is visible in profiler traces); with
+        # config.measure_phases the attempt runs as two programs and JMPI is
+        # recorded from the host clock (Measurements.cpp:139-141 parity).
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
@@ -560,17 +728,27 @@ class HashJoin:
         if m:
             m.stop("SWINALLOC")
         local_slack = 1
+        # the split is honored with or without a registry (a profiler-trace
+        # user still gets two separate programs); only the host timers need m
+        use_split = (self.config.measure_phases
+                     and not self._single_node_sort_probe())
         for attempt in range(self.config.max_retries + 1):
-            if m:
-                m.start("JCOMPILE")
-            fn = self._get_compiled(r, s, cap_r, cap_s, local_slack, skew_plan)
-            if m:
-                m.stop("JCOMPILE")
-                m.start("JPROC")
-            counts, flags = fn(r, s)
-            if m:
-                m.stop("JPROC", fence=(counts, flags))
-            flags = np.asarray(flags)
+            if use_split:
+                counts, flags, dt_mpi, dt_proc = self._run_split(
+                    r, s, cap_r, cap_s, local_slack, skew_plan)
+            else:
+                if m:
+                    m.start("JCOMPILE")
+                fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
+                                        skew_plan)
+                if m:
+                    m.stop("JCOMPILE")
+                    m.start("JPROC")
+                counts, flags = fn(r, s)
+                dt_mpi = 0.0
+                dt_proc = (m.stop("JPROC", fence=(counts, flags))
+                           if m else 0.0)
+                flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
@@ -584,8 +762,18 @@ class HashJoin:
                 local_slack *= 2
             if diag["hot_overflow"]:
                 skew_plan = (skew_plan[0], 2 * skew_plan[1])
-            if m:
+            if m and attempt < self.config.max_retries:
+                # A superseded attempt's device time is window-wait, not join
+                # work: reclassify it as MWINWAIT (the reference's stall
+                # column, Measurements.cpp:272-349) so JMPI/JPROC report only
+                # the attempt that produced the result.  When retries are
+                # exhausted the last attempt IS the result — keep its time.
                 m.incr("RETRIES")
+                m.add_time_us("MWINWAIT", dt_mpi + dt_proc)
+                if dt_proc:
+                    m.times_us["JPROC"] -= dt_proc
+                if dt_mpi:
+                    m.times_us["JMPI"] -= dt_mpi
         counts = np.asarray(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
@@ -641,8 +829,7 @@ class HashJoin:
                 m.stop("JCOMPILE")
                 m.start("JPROC")
             r_rid, s_rid, valid, flags = self._compiled[key](r, s)
-            if m:
-                m.stop("JPROC", fence=(r_rid, flags))
+            dt_proc = (m.stop("JPROC", fence=(r_rid, flags)) if m else 0.0)
             flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
@@ -653,8 +840,10 @@ class HashJoin:
                 cap_s *= 2
             if diag["local_overflow"]:        # match-rate cap shortfall
                 rate_cap *= 2
-            if m:
+            if m and attempt < self.config.max_retries:
                 m.incr("RETRIES")
+                m.add_time_us("MWINWAIT", dt_proc)
+                m.times_us["JPROC"] -= dt_proc
         valid = np.asarray(valid)
         r_rid = np.asarray(r_rid)[valid]
         s_rid = np.asarray(s_rid)[valid]

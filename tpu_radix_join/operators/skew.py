@@ -32,6 +32,8 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
+from tpu_radix_join.utils.hashing import mix32
+
 # The in-program hot test is a vectorized bit probe against one uint32
 # constant, so the splittable fanout is capped at 32 partitions (the
 # reference's default NETWORK_PARTITIONING_COUNT, Configuration.h:33).
@@ -76,18 +78,12 @@ def spread_destinations(rid: jnp.ndarray, num_nodes: int) -> jnp.ndarray:
     modulo the mesh size (the analog of generate_block_mapping distributing a
     hot partition's chunks over blocks, kernels_optimized.cu:321-344).
 
-    The mix (splitmix32-style xorshift-multiply finalizer) matters: raw
-    ``rid % n`` puts every tuple of a pre-filtered/strided outer side whose
-    rids are congruent mod n back on ONE device — silently recreating the
-    skew the split exists to fix.  The sizing program and the shuffle both
-    call this, so measured capacities stay exact for any rid pattern."""
-    x = rid.astype(jnp.uint32)
-    x = x ^ (x >> 16)
-    x = x * jnp.uint32(0x7FEB352D)
-    x = x ^ (x >> 15)
-    x = x * jnp.uint32(0x846CA68B)
-    x = x ^ (x >> 16)
-    return x % jnp.uint32(num_nodes)
+    The mix (utils/hashing.mix32) matters: raw ``rid % n`` puts every tuple
+    of a pre-filtered/strided outer side whose rids are congruent mod n back
+    on ONE device — silently recreating the skew the split exists to fix.
+    The sizing program and the shuffle both call this, so measured
+    capacities stay exact for any rid pattern."""
+    return mix32(rid) % jnp.uint32(num_nodes)
 
 
 def mask_hot(hist: jnp.ndarray, hot_bits: int) -> jnp.ndarray:

@@ -45,8 +45,9 @@ def local_join_merge(r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
     no gathers).  32-bit keys only (compares the low lane)."""
     if r.key_hi is not None or s.key_hi is not None:
         raise NotImplementedError(
-            "local_join_merge compares the 32-bit key lane only; use "
-            "probe_count (x64) for 64-bit keys")
+            "local_join_merge compares the 32-bit key lane only; 64-bit "
+            "keys take merge_count.merge_count_wide_per_partition (hi/lo "
+            "lexicographic, x64-free)")
     return _local_join_merge(r.key, s.key)
 
 

@@ -41,6 +41,8 @@ SWINALLOC = "SWINALLOC"    # window allocation (capacity measurement + compile)
 SNETCOMPL = "SNETCOMPL"    # network completion wait
 SLOCPREP = "SLOCPREP"      # local preparation
 
+MWINWAIT = "MWINWAIT"      # time spent on retried (undersized-window) attempts
+
 # Detail tags (MEASUREMENT_DETAILS_* analogs).  Counters carry the exact
 # quantities the reference sums per call site; rates are derived on report.
 RTUPLES = "RTUPLES"        # inner tuples joined (counter)
@@ -184,7 +186,11 @@ class Measurements:
         for name in sorted(os.listdir(out_dir)):
             if not name.endswith(".perf"):
                 continue
-            m = cls(node_id=int(name[:-5]))
+            try:
+                node_id = int(name[:-5])
+            except ValueError:
+                continue   # stray non-rank .perf file (e.g. notes.perf)
+            m = cls(node_id=node_id)
             with open(os.path.join(out_dir, name)) as f:
                 for line in f:
                     key, value, unit = line.rstrip("\n").split("\t")

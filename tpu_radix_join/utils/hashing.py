@@ -1,0 +1,36 @@
+"""Shared 32-bit integer mixing (lowbias32-style xorshift-multiply finalizer).
+
+One definition, two twins (device / numpy, bit-identical), consumed by the
+wide-key hi-lane derivation (data/relation.py) and the hot-outer spread
+(operators/skew.py) — the constants must never drift apart between callers
+or between host and device paths.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_M1 = 0x7FEB352D
+_M2 = 0x846CA68B
+
+
+def mix32(x: jnp.ndarray) -> jnp.ndarray:
+    """Bijective uint32 mix (device twin of :func:`mix32_np`)."""
+    x = x.astype(jnp.uint32)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(_M1)
+    x = x ^ (x >> 15)
+    x = x * jnp.uint32(_M2)
+    return x ^ (x >> 16)
+
+
+def mix32_np(x: np.ndarray) -> np.ndarray:
+    """Bijective uint32 mix (numpy twin of :func:`mix32`)."""
+    x = x.astype(np.uint32)
+    with np.errstate(over="ignore"):
+        x = x ^ (x >> np.uint32(16))
+        x = x * np.uint32(_M1)
+        x = x ^ (x >> np.uint32(15))
+        x = x * np.uint32(_M2)
+        return x ^ (x >> np.uint32(16))
