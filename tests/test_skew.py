@@ -159,7 +159,20 @@ def test_config_rejects_unsupported_skew_combos():
         JoinConfig(skew_threshold=2.0, network_fanout_bits=6)
     with pytest.raises(ValueError):
         JoinConfig(skew_threshold=2.0, window_sizing="static")
-    with pytest.raises(NotImplementedError):
-        cfg = JoinConfig(num_nodes=2, skew_threshold=2.0)
-        r = Relation(1 << 10, 2, "unique", seed=1)
-        HashJoin(cfg).join_materialize(r, r)
+
+
+def test_materialize_with_skew_split():
+    """join_materialize under the hot-partition split emits exactly the
+    pairs the unsplit pipeline does (the probe_match_rate arm of the skew
+    machinery, kernels_optimized.cu:689-787)."""
+    n, size = 8, 1 << 13
+    r, s = _hot_workload(size)
+    base = dict(num_nodes=n, match_rate_cap=4, max_retries=1)
+    split = HashJoin(JoinConfig(**base, skew_threshold=4.0)
+                     ).join_materialize_arrays(r, s)
+    plain = HashJoin(JoinConfig(**base)).join_materialize_arrays(r, s)
+    assert split.ok, split.diagnostics
+    assert plain.ok and split.matches == plain.matches == size
+    want = set(zip(plain.r_rid.tolist(), plain.s_rid.tolist()))
+    got = set(zip(split.r_rid.tolist(), split.s_rid.tolist()))
+    assert got == want

@@ -42,6 +42,7 @@ from tpu_radix_join.ops.build_probe import (
     probe_count_bucketized,
     probe_count_chunked,
     probe_materialize,
+    probe_materialize_chunked,
 )
 from tpu_radix_join.ops.merge_count import (
     MAX_MERGE_KEY,
@@ -610,11 +611,18 @@ class HashJoin:
             bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)
         return rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad
 
-    def _materialize_fn(self, cap_r: int, cap_s: int, rate_cap: int):
+    def _materialize_fn(self, cap_r: int, cap_s: int, rate_cap: int,
+                        skew_plan=None):
         """Pipeline variant that emits rid pairs instead of counts — the
         distributed realisation of the dormant GPU ``probe_match_rate``
         capability (kernels.cu:314-411): static [outer_slots * cap] output
-        buffers per device, overflow reported, never silently truncated."""
+        buffers per device, overflow reported, never silently truncated.
+        With a ``skew_plan`` the hot build side arrives replicated
+        (operators/skew.py) and joins the local probe input — hot R and
+        non-hot receive-buffer keys live in disjoint partitions, so each
+        (r_rid, s_rid) pair is still emitted exactly once (the
+        probe_match_rate arm of the SD::OPT skew machinery,
+        kernels_optimized.cu:689-787)."""
         cfg = self.config
         ax = cfg.mesh_axes
         n = cfg.num_nodes
@@ -624,18 +632,31 @@ class HashJoin:
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
                 jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
-            rp, sp, _, lost_r, lost_s, _, conserve_bad = self._shuffle(
-                r, s, win_r, win_s)
-            m = probe_materialize(_as_compressed(rp.batch),
-                                  _as_compressed(sp.batch), rate_cap)
-            zero = jnp.uint32(0)
+            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
+                self._shuffle(r, s, win_r, win_s, skew_plan)
+            rb = rp.batch
+            if hot_batch is not None:
+                rb = TupleBatch(
+                    key=jnp.concatenate([rb.key, hot_batch.key]),
+                    rid=jnp.concatenate([rb.rid, hot_batch.rid]),
+                    key_hi=None if rb.key_hi is None else jnp.concatenate(
+                        [rb.key_hi, hot_batch.key_hi]))
+            if cfg.chunk_size:
+                # out-of-core discipline for the materializing probe too
+                # (LD output kernels, kernels.cu:778-856)
+                m = probe_materialize_chunked(
+                    _as_compressed(rb), _as_compressed(sp.batch),
+                    rate_cap, cfg.chunk_size)
+            else:
+                m = probe_materialize(_as_compressed(rb),
+                                      _as_compressed(sp.batch), rate_cap)
             flags = jnp.stack([
                 jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
                 lost_r.astype(jnp.uint32),
                 lost_s.astype(jnp.uint32),
                 conserve_bad.astype(jnp.uint32),
                 jax.lax.psum(m.overflow.astype(jnp.uint32), ax),
-                zero,
+                hot_overflow.astype(jnp.uint32),
             ])
             return m.r_rid, m.s_rid, m.valid, flags
 
@@ -799,31 +820,23 @@ class HashJoin:
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
         self._check_key_width(r, s)
-        if self.config.chunk_size:
-            raise NotImplementedError(
-                "materializing probe has no chunked variant; unset chunk_size "
-                "(the count path honors it)")
-        if self.config.skew_threshold is not None:
-            raise NotImplementedError(
-                "materializing probe has no skew-split path; unset "
-                "skew_threshold (the count path honors it)")
         m = self.measurements
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
-        cap_r, cap_s, _ = self._measure_capacities(r, s)
+        cap_r, cap_s, skew_plan = self._measure_capacities(r, s)
         if m:
             m.stop("SWINALLOC")
         rate_cap = self.config.match_rate_cap
         for attempt in range(self.config.max_retries + 1):
             key = ("mat", r.size // n, s.size // n, cap_r, cap_s, rate_cap,
-                   r.key_hi is None, s.key_hi is None,
+                   skew_plan, r.key_hi is None, s.key_hi is None,
                    getattr(r.key, "sharding", None),
                    getattr(s.key, "sharding", None))
             if m:
                 m.start("JCOMPILE")
             if key not in self._compiled:
-                fn = self._materialize_fn(cap_r, cap_s, rate_cap)
+                fn = self._materialize_fn(cap_r, cap_s, rate_cap, skew_plan)
                 self._compiled[key] = fn.lower(r, s).compile()
             if m:
                 m.stop("JCOMPILE")
@@ -840,6 +853,8 @@ class HashJoin:
                 cap_s *= 2
             if diag["local_overflow"]:        # match-rate cap shortfall
                 rate_cap *= 2
+            if diag["hot_overflow"]:
+                skew_plan = (skew_plan[0], 2 * skew_plan[1])
             if m and attempt < self.config.max_retries:
                 m.incr("RETRIES")
                 m.add_time_us("MWINWAIT", dt_proc)

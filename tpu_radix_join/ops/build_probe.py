@@ -324,17 +324,115 @@ def probe_materialize(
             valid=valid.reshape(-1), overflow=overflow,
         )
     r_sorted, r_rid_sorted = sort_kv_unstable(_sort_key(inner), inner.rid)
-    sk = _sort_key(outer)
-    lo = jnp.searchsorted(r_sorted, sk, side="left", method="sort")
-    hi = jnp.searchsorted(r_sorted, sk, side="right", method="sort")
-    n_outer = sk.shape[0]
-    idx = lo[:, None] + k                                      # [n_outer, cap]
-    valid = idx < hi[:, None]
-    idx = jnp.minimum(idx, r_sorted.shape[0] - 1)
-    r_rid = r_rid_sorted[idx]
-    s_rid = jnp.broadcast_to(outer.rid[:, None], (n_outer, cap))
-    overflow = jnp.sum(((hi - lo) > cap).astype(jnp.uint32))
+    r_rid, s_rid, valid, overflow = _materialize_rows_narrow(
+        r_sorted, r_rid_sorted, _sort_key(outer), outer.rid, cap)
     return MaterializedMatches(
         r_rid=r_rid.reshape(-1), s_rid=s_rid.reshape(-1),
         valid=valid.reshape(-1), overflow=overflow,
     )
+
+
+def _materialize_rows_narrow(r_sorted, r_rid_sorted, outer_keys, outer_rids,
+                             cap: int):
+    """Narrow-key materialization core against a pre-sorted inner side:
+    ([n, cap] r_rid, [n, cap] s_rid, [n, cap] valid, overflow) — shared by
+    the resident probe and each slab of the chunked probe."""
+    k = jnp.arange(cap, dtype=jnp.int32)[None, :]              # [1, cap]
+    lo = jnp.searchsorted(r_sorted, outer_keys, side="left", method="sort")
+    hi = jnp.searchsorted(r_sorted, outer_keys, side="right", method="sort")
+    idx = lo[:, None] + k                                      # [n, cap]
+    valid = idx < hi[:, None]
+    idx = jnp.minimum(idx, r_sorted.shape[0] - 1)
+    r_rid = r_rid_sorted[idx]
+    s_rid = jnp.broadcast_to(outer_rids[:, None], idx.shape)
+    overflow = jnp.sum(((hi - lo) > cap).astype(jnp.uint32))
+    return r_rid, s_rid, valid, overflow
+
+
+def probe_materialize_chunked(
+    inner: CompressedBatch, outer: CompressedBatch, cap: int, slab_size: int
+) -> MaterializedMatches:
+    """Materializing probe with the outer side streamed in ``slab_size``
+    slabs under ``lax.scan`` — the output-producing form of the reference's
+    LD chunked kernels, which write match arrays per ``iterCount`` chunk
+    (kernels.cu:778-856: probe writes R[], S[] output columns per chunk).
+
+    Same contract and output size as :func:`probe_materialize` for narrow
+    keys (``n_outer_padded * cap`` rows); wide-key output is also
+    ``n_outer_padded * cap`` — each slab's union-scan rows are compacted
+    back to slab positions before stacking, so shrinking the slab (the
+    out-of-core lever) never inflates the result buffer.  The per-step
+    intermediate working set is O(inner + slab) instead of
+    O(inner + outer).  The outer buffer is padded to a slab multiple with S
+    sentinels (match nothing, valid=False); overflow is summed across slabs.
+    """
+    n = outer.size
+    pad = (-n) % slab_size
+    fill = int(pad_sentinel("outer"))
+    wide = inner.key_rem_hi is not None
+
+    def padded(lane, fill_value):
+        if not pad:
+            return lane
+        return jnp.concatenate(
+            [lane, jnp.full((pad,), fill_value, lane.dtype)])
+
+    s_rid = padded(outer.rid, 0xFFFFFFFF)
+    s_lo = padded(outer.key_rem, fill)
+    if wide:
+        s_hi = padded(outer.key_rem_hi, fill)
+        # inner sorted once, resident across slabs (matches the narrow path)
+        _, _, r_rid_sorted = sort_lex_unstable(
+            inner.key_rem_hi, inner.key_rem, inner.rid, num_keys=2)
+        k = jnp.arange(cap, dtype=jnp.int32)[None, :]
+        pos_lane = jnp.arange(slab_size, dtype=jnp.uint32)
+
+        def step_wide(carry, slab):
+            lo, hi, rid = slab
+            sb = CompressedBatch(key_rem=lo, rid=rid, key_rem_hi=hi)
+            tag, base, c_r, rid_sorted, pos_sorted = _wide_union_scan(
+                inner, sb, rid, pos_lane)
+            is_outer = tag.astype(jnp.int32)
+            idx = base[:, None] + k                    # [n_r + slab, cap]
+            valid = (idx < c_r[:, None]) & (is_outer[:, None] == 1)
+            idx = jnp.minimum(idx, inner.size - 1)
+            rows_r = r_rid_sorted[idx]
+            rows_s = jnp.broadcast_to(rid_sorted[:, None], idx.shape)
+            # compact union rows back to slab positions: inner rows carry the
+            # PAD_RID pos lane (out of range) and drop
+            pos = jnp.where(tag == 1, pos_sorted, jnp.uint32(slab_size))
+            shape = (slab_size, cap)
+            out_r = jnp.zeros(shape, jnp.uint32).at[pos].set(
+                rows_r, mode="drop")
+            out_s = jnp.zeros(shape, jnp.uint32).at[pos].set(
+                rows_s, mode="drop")
+            out_v = jnp.zeros(shape, bool).at[pos].set(valid, mode="drop")
+            ovf = jnp.sum((((c_r - base) > cap) & (is_outer == 1))
+                          .astype(jnp.uint32))
+            return carry, (out_r.reshape(-1), out_s.reshape(-1),
+                           out_v.reshape(-1), ovf)
+
+        _, (rr, sr, vv, ovf) = jax.lax.scan(
+            step_wide, (), (s_lo.reshape(-1, slab_size),
+                            s_hi.reshape(-1, slab_size),
+                            s_rid.reshape(-1, slab_size)))
+        return MaterializedMatches(
+            r_rid=rr.reshape(-1), s_rid=sr.reshape(-1),
+            valid=vv.reshape(-1),
+            overflow=jnp.sum(ovf, dtype=jnp.uint32))
+
+    r_sorted, r_rid_sorted = sort_kv_unstable(_sort_key(inner), inner.rid)
+
+    def step(carry, slab):
+        keys, rids = slab
+        r_rid, s_rid_b, valid, ovf = _materialize_rows_narrow(
+            r_sorted, r_rid_sorted, keys, rids, cap)
+        return carry, (r_rid.reshape(-1), s_rid_b.reshape(-1),
+                       valid.reshape(-1), ovf)
+
+    _, (rr, sr, vv, ovf) = jax.lax.scan(
+        step, (), (s_lo.reshape(-1, slab_size),
+                   s_rid.reshape(-1, slab_size)))
+    return MaterializedMatches(
+        r_rid=rr.reshape(-1), s_rid=sr.reshape(-1), valid=vv.reshape(-1),
+        overflow=jnp.sum(ovf, dtype=jnp.uint32))
