@@ -1,0 +1,53 @@
+"""Subprocess worker for the real multi-process plumbing test
+(test_multihost.py::test_two_process_plumbing): one rank of an N-process CPU
+world — 4 virtual devices per process, ``jax.distributed`` over a localhost
+coordinator (the ``mpirun`` analog, main.cpp:36-48), hierarchical
+(dcn=N, ici=4) mesh join, and the rank-0 measurement gather
+(Measurements.cpp:548-590).  Not a pytest module (no ``test_`` prefix)."""
+
+import sys
+
+
+def main(port: str, rank: str, nproc: str) -> None:
+    # must precede any JAX backend use (tests/_multiproc_worker is launched
+    # with a clean env; sitecustomize still pre-imports jax), and must NOT
+    # itself touch jax.devices() — distributed.initialize comes first
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(4, defer_check=True)
+
+    import jax
+    from tpu_radix_join.parallel.multihost import initialize, process_info
+
+    nproc = int(nproc)
+    assert initialize(coordinator_address=f"127.0.0.1:{port}",
+                      num_processes=nproc, process_id=int(rank))
+    pid, pcount = process_info()
+    assert pcount == nproc, (pid, pcount)
+    assert jax.local_device_count() == 4
+    assert jax.device_count() == 4 * nproc
+
+    from tpu_radix_join import HashJoin, JoinConfig, Relation
+    from tpu_radix_join.performance import Measurements, print_results
+
+    n = jax.device_count()
+    cfg = JoinConfig(num_nodes=n, num_hosts=nproc)
+    size = 1 << 12
+    r = Relation(size, n, "unique", seed=1)
+    s = Relation(size, n, "unique", seed=9)
+    m = Measurements(node_id=pid, num_nodes=nproc)
+    res = HashJoin(cfg, measurements=m).join(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size, res.matches
+
+    all_m = m.gather_all()
+    assert len(all_m) == nproc, len(all_m)
+    assert sorted(mm.node_id for mm in all_m) == list(range(nproc))
+    if pid == 0:
+        assert all(mm.times_us.get("JTOTAL", 0) > 0 for mm in all_m)
+        print_results(all_m)
+        print(f"MULTIPROC_OK matches={res.matches} ranks={len(all_m)}")
+    print(f"RANK_DONE {pid}")
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:4])

@@ -80,7 +80,7 @@ def main(argv=None) -> int:
     from tpu_radix_join.parallel.multihost import initialize as init_multihost
     from tpu_radix_join.performance import Measurements
 
-    init_multihost()   # no-op unless a multi-process world is configured
+    distributed = init_multihost()   # no-op unless a world is configured
     nodes = args.nodes or jax.device_count()
     cfg = JoinConfig(
         num_nodes=nodes,
@@ -108,7 +108,7 @@ def main(argv=None) -> int:
     outer = Relation(global_size, nodes, args.outer_kind,
                      seed=args.seed + 1, **outer_kw)
 
-    meas = Measurements(node_id=0, num_nodes=nodes)
+    meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
     meas.meta.update(tuples_per_node=args.tuples_per_node,
                      global_size=global_size, config=vars(args))
     engine = HashJoin(cfg, measurements=meas)
@@ -118,24 +118,35 @@ def main(argv=None) -> int:
     for i in range(args.repeat):
         result = engine.join(inner, outer)
 
-    # The reference's rank-0 aggregate report (Measurements.cpp:592-702)
-    print(f"[RESULTS] Tuples: {result.matches}")
-    if expected is not None:
-        status = "OK" if result.matches == expected else "MISMATCH"
-        print(f"[RESULTS] Expected: {expected} ({status})")
-    print(f"[RESULTS] Conservation: {'OK' if result.ok else 'VIOLATED'}")
-    if not result.ok and result.diagnostics:
-        for k, v in result.diagnostics.items():
-            print(f"[RESULTS] failure/{k}: {v}")
-    total_us = meas.times_us.get("JTOTAL", 0.0)
-    if total_us:
-        rate = (2 * global_size * args.repeat) / (total_us / 1e6)
-        print(f"[RESULTS] Throughput: {rate / 1e6:.1f} M tuples/sec")
-    for line in meas.lines():
-        print(f"[PERF] {line}")
+    # The reference's rank-0 aggregate report (Measurements.cpp:592-702):
+    # multi-process worlds gather every rank's registry over the network
+    # first (Measurements.gather_all); rank 0 alone prints.
+    all_meas = meas.gather_all() if distributed else [meas]
+    if jax.process_index() == 0:
+        if len(all_meas) == 1:
+            # multi-rank runs get this line from print_results below
+            print(f"[RESULTS] Tuples: {result.matches}")
+        if expected is not None:
+            status = "OK" if result.matches == expected else "MISMATCH"
+            print(f"[RESULTS] Expected: {expected} ({status})")
+        print(f"[RESULTS] Conservation: {'OK' if result.ok else 'VIOLATED'}")
+        if not result.ok and result.diagnostics:
+            for k, v in result.diagnostics.items():
+                print(f"[RESULTS] failure/{k}: {v}")
+        total_us = meas.times_us.get("JTOTAL", 0.0)
+        if total_us:
+            rate = (2 * global_size * args.repeat) / (total_us / 1e6)
+            print(f"[RESULTS] Throughput: {rate / 1e6:.1f} M tuples/sec")
+        if len(all_meas) > 1:
+            from tpu_radix_join.performance import print_results
+            print_results(all_meas)
+        else:
+            for line in meas.lines():
+                print(f"[PERF] {line}")
     if args.output_dir:
         path = meas.store(args.output_dir)
-        print(f"[PERF] stored {path}")
+        if jax.process_index() == 0:
+            print(f"[PERF] stored {path}")
 
     bad = (expected is not None and result.matches != expected) or not result.ok
     return 1 if bad else 0

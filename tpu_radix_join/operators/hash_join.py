@@ -215,7 +215,7 @@ class HashJoin:
         r_demand, s_demand, r_gh, s_gh, _ = self._run_hist(r, s, 0)
 
         def cap(demand):
-            worst = max(1, int(np.asarray(demand).max()))
+            worst = max(1, int(self._to_host(demand).max()))
             return max(8, 1 << (worst - 1).bit_length())
 
         skew_plan = None
@@ -686,6 +686,17 @@ class HashJoin:
         return self._compiled[key]
 
     @staticmethod
+    def _to_host(x) -> np.ndarray:
+        """Device -> host readback that also works for arrays sharded across
+        *processes* (multi-host worlds): non-addressable shards are
+        allgathered first — the result-gather the reference does over MPI
+        (main.cpp:120-135).  Single-process arrays convert directly."""
+        if getattr(x, "is_fully_addressable", True):
+            return np.asarray(x)
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+    @staticmethod
     def _flags_to_diag(flags: np.ndarray) -> dict:
         """Failure breakdown from the pipeline's reduced flag vector.  The
         two shuffle overflows are per relation so a retry grows only the
@@ -795,7 +806,7 @@ class HashJoin:
                     m.times_us["JPROC"] -= dt_proc
                 if dt_mpi:
                     m.times_us["JMPI"] -= dt_mpi
-        counts = np.asarray(counts)
+        counts = self._to_host(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
             m.stop("JTOTAL")
@@ -859,9 +870,9 @@ class HashJoin:
                 m.incr("RETRIES")
                 m.add_time_us("MWINWAIT", dt_proc)
                 m.times_us["JPROC"] -= dt_proc
-        valid = np.asarray(valid)
-        r_rid = np.asarray(r_rid)[valid]
-        s_rid = np.asarray(s_rid)[valid]
+        valid = self._to_host(valid)
+        r_rid = self._to_host(r_rid)[valid]
+        s_rid = self._to_host(s_rid)[valid]
         if m:
             m.stop("JTOTAL")
             m.incr("RESULTS", int(valid.sum()))
@@ -893,10 +904,19 @@ class HashJoin:
                 f"config.key_bits={self.config.key_bits} but relation shards "
                 f"{'carry' if wide else 'lack'} a hi key lane — widen the "
                 f"config or regenerate with the matching key_bits")
-        keys = jax.device_put(np.concatenate([sh[0] for sh in shards]), sharding)
-        rids = jax.device_put(np.concatenate([sh[-1] for sh in shards]), sharding)
-        hi = (jax.device_put(np.concatenate([sh[1] for sh in shards]), sharding)
-              if wide else None)
+
+        def put(arrs):
+            full = np.concatenate(arrs)
+            if sharding.is_fully_addressable:
+                return jax.device_put(full, sharding)
+            # multi-process mesh: every process generates the same global
+            # relation and contributes only its addressable shards
+            return jax.make_array_from_callback(
+                full.shape, sharding, lambda idx: full[idx])
+
+        keys = put([sh[0] for sh in shards])
+        rids = put([sh[-1] for sh in shards])
+        hi = put([sh[1] for sh in shards]) if wide else None
         return TupleBatch(key=keys, rid=rids, key_hi=hi)
 
     def join(self, inner: Relation, outer: Relation) -> JoinResult:

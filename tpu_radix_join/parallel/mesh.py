@@ -66,9 +66,19 @@ def make_hierarchical_mesh(
         raise ValueError(f"{n} devices do not divide over {num_hosts} hosts")
     per_host = n // num_hosts
     if jax.process_count() > 1:
-        from jax.experimental import mesh_utils
-        grid = mesh_utils.create_hybrid_device_mesh(
-            (1, per_host), (num_hosts, 1), devices=devs[:n])
+        try:
+            from jax.experimental import mesh_utils
+            grid = mesh_utils.create_hybrid_device_mesh(
+                (1, per_host), (num_hosts, 1), devices=devs[:n])
+        except ValueError:
+            # no slice topology (e.g. multi-process virtual CPU devices):
+            # group rows by owning process — valid only when hosts and
+            # processes coincide, else the "dcn" axis would not cross
+            # process boundaries and the misconfiguration must surface
+            if num_hosts != jax.process_count():
+                raise
+            ordered = sorted(devs[:n], key=lambda d: (d.process_index, d.id))
+            grid = np.asarray(ordered).reshape(num_hosts, per_host)
     else:
         grid = np.asarray(devs[:n]).reshape(num_hosts, per_host)
     return Mesh(grid, tuple(axis))

@@ -43,6 +43,8 @@ SLOCPREP = "SLOCPREP"      # local preparation
 
 MWINWAIT = "MWINWAIT"      # time spent on retried (undersized-window) attempts
 
+_GATHER_BUF_BYTES = 1 << 16   # fixed allgather slot per process (gather_all)
+
 # Detail tags (MEASUREMENT_DETAILS_* analogs).  Counters carry the exact
 # quantities the reference sums per call site; rates are derived on report.
 RTUPLES = "RTUPLES"        # inner tuples joined (counter)
@@ -177,6 +179,52 @@ class Measurements:
                 **{k: float(v) for k, v in self.counters.items()}}
 
     # ----------------------------------------------------------- aggregation
+    def gather_all(self) -> List["Measurements"]:
+        """Network gather of every process's registry — the analog of the
+        reference's rank-0 result gather over MPI_Send/Recv
+        (serializeResults/receiveAllMeasurements, Measurements.cpp:548-590).
+        Replaces the shared-directory assumption of :meth:`load` for
+        multi-process worlds: each process JSON-serializes its registry into
+        a fixed-size byte buffer and an allgather hands every process all of
+        them (rank 0 reports; the others get the same data for free, which
+        the reference's point-to-point gather cannot do).  Single-process
+        worlds return ``[self]`` without touching the runtime."""
+        import jax as _jax
+        if _jax.process_count() == 1:
+            return [self]
+        import numpy as np
+        from jax.experimental import multihost_utils
+        payload = json.dumps({
+            "node": self.node_id,
+            "num_nodes": self.num_nodes,
+            "times_us": self.times_us,
+            "counters": self.counters,
+            "meta": self.meta,
+        }, default=str).encode()
+        cap = _GATHER_BUF_BYTES - 4
+        if len(payload) > cap:
+            raise ValueError(
+                f"measurement payload ({len(payload)}B) exceeds the "
+                f"{cap}B gather buffer")
+        buf = np.zeros(_GATHER_BUF_BYTES, np.uint8)
+        buf[:4] = np.frombuffer(
+            np.uint32(len(payload)).tobytes(), dtype=np.uint8)
+        buf[4:4 + len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+        rows = np.asarray(multihost_utils.process_allgather(buf))
+        out = []
+        for row in rows:
+            n = int(np.frombuffer(row[:4].tobytes(), dtype=np.uint32)[0])
+            rec = json.loads(row[4:4 + n].tobytes().decode())
+            m = Measurements(node_id=int(rec["node"]),
+                             num_nodes=int(rec["num_nodes"]))
+            m.times_us.update({k: float(v)
+                               for k, v in rec["times_us"].items()})
+            m.counters.update({k: int(v)
+                               for k, v in rec["counters"].items()})
+            m.meta = rec["meta"]
+            out.append(m)
+        return out
+
     @classmethod
     def load(cls, out_dir: str) -> List["Measurements"]:
         """Read every ``<rank>.perf`` in a directory back into registries —

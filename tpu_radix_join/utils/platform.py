@@ -21,7 +21,8 @@ import re
 _FLAG = "--xla_force_host_platform_device_count"
 
 
-def force_host_cpu_devices(n: int, respect_existing: bool = False) -> None:
+def force_host_cpu_devices(n: int, respect_existing: bool = False,
+                           defer_check: bool = False) -> None:
     """Make ``jax.devices()`` return at least ``n`` virtual CPU devices.
 
     Must run before any JAX backend use in this process; raises RuntimeError
@@ -29,6 +30,11 @@ def force_host_cpu_devices(n: int, respect_existing: bool = False) -> None:
     Replaces an existing device-count flag so the caller's ``n`` wins, unless
     ``respect_existing`` and the env already requests ``>= n`` devices (so
     e.g. ``XLA_FLAGS=...device_count=16 pytest`` still gets its 16).
+
+    ``defer_check=True`` skips the ``jax.devices()`` validation, which itself
+    initializes the backend — required when ``jax.distributed.initialize``
+    must still run after this call (multi-process workers), since it refuses
+    to run once any backend exists.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     existing = re.search(rf"{_FLAG}=(\d+)", flags)
@@ -50,6 +56,8 @@ def force_host_cpu_devices(n: int, respect_existing: bool = False) -> None:
     except (ImportError, AttributeError):
         already_initialized = False
     jax.config.update("jax_platforms", "cpu")
+    if defer_check:
+        return
     if len(jax.devices()) < n:
         hint = (
             "a JAX backend was already initialized in this process, so the "
