@@ -40,6 +40,7 @@ def _time_amortized(fn, args, iters=20):
 
 def main():
     import jax
+    import jax.numpy as jnp
     from tpu_radix_join.data.relation import Relation
     from tpu_radix_join.ops.merge_count import merge_count_chunks, merge_count_pallas
 
@@ -106,6 +107,63 @@ def main():
     except Exception as e:
         print(f"note: pipeline timing unavailable ({type(e).__name__}: {e})",
               file=sys.stderr)
+
+    # Wide-key (64-bit) fused Pallas kernel: hardware validation + timing
+    # (r2 weak #3 — interpret-mode-only until now).  Hi lanes derived the
+    # same way Relation(key_bits=64) derives them.
+    try:
+        from tpu_radix_join.data.relation import key_hi_lane
+        from tpu_radix_join.ops.merge_count import (
+            merge_count_wide_per_partition)
+        r_hi = key_hi_lane(r.key)
+        s_hi = key_hi_lane(s.key)
+
+        def wide(impl):
+            return jax.jit(lambda a, b, c, d: merge_count_wide_per_partition(
+                a, b, c, d, 5, impl=impl))
+
+        args = (r.key, r_hi, s.key, s_hi)
+        fp, fx = wide("pallas"), wide("xla")
+        # validation calls double as compile warmup for the timed fn objects
+        cp = np.asarray(fp(*args)).astype(np.uint64)
+        cx = np.asarray(fx(*args)).astype(np.uint64)
+        if not np.array_equal(cp, cx):
+            print(f"WARNING: wide pallas != xla ({cp.sum()} vs {cx.sum()})",
+                  file=sys.stderr)
+        elif cp.sum() != size:
+            print(f"WARNING: wide kernels miscount ({cp.sum()} != {size})",
+                  file=sys.stderr)
+        else:
+            dtp = _time_amortized(fp, args)
+            dtx = _time_amortized(fx, args)
+            print(f"note: wide_pallas: {dtp*1e3:.1f} ms/iter (== xla counts); "
+                  f"wide_xla: {dtx*1e3:.1f} ms/iter", file=sys.stderr)
+    except Exception as e:
+        print(f"note: wide kernel bench unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
+
+    # Weighted (masked) Pallas histogram: backs the skew spread-demand pass
+    try:
+        from tpu_radix_join.ops.radix import local_histogram
+        pid = r.key & jnp.uint32(31)
+        mask = (r.key & jnp.uint32(1)).astype(bool)
+
+        def hist(impl):
+            return jax.jit(lambda p, w: local_histogram(p, 32, valid=w,
+                                                        impl=impl))
+
+        hfp, hfx = hist("pallas"), hist("xla")
+        hp = np.asarray(hfp(pid, mask))
+        hx = np.asarray(hfx(pid, mask))
+        if not np.array_equal(hp, hx):
+            print("WARNING: weighted histogram pallas != xla", file=sys.stderr)
+        else:
+            dth = _time_amortized(hfp, (pid, mask))
+            print(f"note: weighted_histogram_pallas: {dth*1e3:.1f} ms/iter "
+                  f"(== xla)", file=sys.stderr)
+    except Exception as e:
+        print(f"note: weighted histogram bench unavailable "
+              f"({type(e).__name__}: {e})", file=sys.stderr)
 
     tuples_per_sec = (2 * size) / dt   # both relations processed
     print(json.dumps({

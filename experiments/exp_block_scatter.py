@@ -1,0 +1,68 @@
+"""On-chip measurement: scatter_to_blocks inner discipline (VERDICT r2 #8).
+
+The send half of every shuffle and local partition routes sorted runs into
+fixed-capacity blocks.  Two exact implementations (ops/radix.py):
+
+  * "loop"   — fori_loop of per-destination dynamic-slice copies
+               (num_blocks sequential DMAs; the round-1/2 shipping path);
+  * "gather" — one vectorized row gather over the [num_blocks, capacity]
+               grid (no sequential dependency).
+
+The reference tunes the same inner loop with SWWC buffers + AVX streams
+(NetworkPartitioning.cpp:224-260).  Run ON THE REAL CHIP:
+
+    python experiments/exp_block_scatter.py
+
+Prints ms/iter for both impls at N=32 and N=64 on a 16M-tuple relation and
+asserts they produce identical blocks.  Measured results live in
+PERF_NOTES.md; the winner is scatter_to_blocks' default.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.radix import scatter_to_blocks
+
+
+def _time(fn, args, iters=20):
+    out = fn(*args)               # compile + correctness reference
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    np.asarray(out[1])            # host readback closes the async window
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    size = 1 << 24
+    rng = np.random.default_rng(0)
+    batch = TupleBatch(
+        key=jnp.asarray(rng.integers(0, 1 << 31, size, dtype=np.uint32)),
+        rid=jnp.arange(size, dtype=jnp.uint32))
+    print(f"device: {jax.devices()[0]}, tuples: {size}")
+    for num_blocks in (32, 64):
+        dest = batch.key % jnp.uint32(num_blocks)
+        capacity = (size // num_blocks) * 2
+
+        results = {}
+        for impl in ("loop", "gather"):
+            fn = jax.jit(
+                lambda b, d, impl=impl: scatter_to_blocks(
+                    b, d, num_blocks, capacity, "inner", impl=impl))
+            dt, out = _time(fn, (batch, dest))
+            results[impl] = (dt, out)
+            print(f"N={num_blocks:3d} impl={impl:6s}: {dt*1e3:8.2f} ms/iter")
+        (_, a), (_, b) = results["loop"], results["gather"]
+        np.testing.assert_array_equal(np.asarray(a[0].key),
+                                      np.asarray(b[0].key))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        print(f"N={num_blocks:3d}: impls identical ok")
+
+
+if __name__ == "__main__":
+    main()

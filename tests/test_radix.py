@@ -1,3 +1,4 @@
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -92,3 +93,26 @@ def test_scatter_overflow_detected():
 def test_exclusive_cumsum():
     h = jnp.asarray([3, 0, 2, 5], jnp.uint32)
     np.testing.assert_array_equal(np.asarray(exclusive_cumsum(h)), [0, 3, 3, 5])
+
+
+def test_scatter_impls_identical():
+    """The "gather" one-shot discipline must produce byte-identical blocks
+    to the "loop" DMA discipline for every shape class — full, partial,
+    empty, and overflowing destinations (exp_block_scatter.py measures which
+    wins on chip; correctness is pinned here)."""
+    rng = np.random.default_rng(5)
+    n = 5000
+    keys = rng.integers(0, 1 << 20, n).astype(np.uint32)
+    # destination 3 empty, destination 0 overflowing
+    dest = rng.choice(np.array([0, 0, 0, 1, 2, 4, 5], np.uint32), n)
+    batch = _comp(keys, np.arange(n))
+    valid = jnp.asarray(rng.random(n) < 0.9)
+    for cap in (512, 2048):
+        a = scatter_to_blocks(batch, jnp.asarray(dest), 6, cap, "inner",
+                              valid=valid, impl="loop")
+        b = scatter_to_blocks(batch, jnp.asarray(dest), 6, cap, "inner",
+                              valid=valid, impl="gather")
+        for la, lb in zip(jax.tree.leaves(a[0]), jax.tree.leaves(b[0])):
+            np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+        np.testing.assert_array_equal(np.asarray(a[1]), np.asarray(b[1]))
+        assert int(a[2]) == int(b[2])

@@ -87,6 +87,7 @@ def scatter_to_blocks(
     capacity: int,
     side: str,
     valid: jnp.ndarray | None = None,
+    impl: str = "loop",
 ):
     """Route tuples into ``num_blocks`` statically-sized blocks of ``capacity``
     slots, padding unused slots with the side's sentinel.
@@ -95,6 +96,16 @@ def scatter_to_blocks(
     ``MPI_Put``s exactly-sized slices computed by OffsetMap
     (``Window.cpp:86-144``), XLA needs static shapes, so each destination gets
     a fixed-capacity block and a valid count (SURVEY.md §7.2).
+
+    ``impl`` selects how the sorted runs land in their blocks (both exact;
+    experiments/exp_block_scatter.py holds the on-chip measurements — the
+    reference has the same obsession with this inner loop's discipline,
+    NetworkPartitioning.cpp:224-260):
+      * "loop" (default): ``fori_loop`` of per-destination dynamic-slice
+        copies — one contiguous DMA per destination, but num_blocks
+        sequential steps.
+      * "gather": ONE vectorized row gather ``lane[starts[d]+j]`` over the
+        [num_blocks, capacity] grid — no sequential dependency.
 
     Returns (blocks batch with arrays shaped [num_blocks * capacity],
     counts uint32 [num_blocks] — the *unclipped* per-destination demand, and
@@ -107,7 +118,7 @@ def scatter_to_blocks(
 
     # One key-value sort carries every lane along (no random gathers — a
     # profiled 3x win over argsort+gather on v5e), then each destination's
-    # run is a *contiguous* slice copied with plain DMAs.  Unstable: tuple
+    # run is a *contiguous* slice of the sorted lanes.  Unstable: tuple
     # order within a destination block is free (the local probe re-sorts).
     lanes, treedef = jax.tree.flatten(batch)
     sorted_all = sort_kv_unstable(sort_key, *lanes)
@@ -121,34 +132,45 @@ def scatter_to_blocks(
     starts = bounds[:-1]
 
     pad_leaves = jax.tree.leaves(make_padding_like(batch, 1, side))
-    padded_lanes = [
-        jnp.concatenate([lane, jnp.full((capacity,), pad[0], lane.dtype)])
-        for lane, pad in zip(sorted_lanes, pad_leaves)
-    ]
+    col = jnp.arange(capacity, dtype=jnp.uint32)[None, :]
+    col_ok = (col < jnp.minimum(counts, jnp.uint32(capacity))[:, None]
+              ).reshape(-1)
 
-    def copy_block(d, outs):
-        return tuple(
-            jax.lax.dynamic_update_slice(
-                out, jax.lax.dynamic_slice(lane, (starts[d],), (capacity,)),
-                (d * capacity,))
-            for out, lane in zip(outs, padded_lanes)
-        )
+    if impl == "gather":
+        n = sorted_dest.shape[0]
+        idx = jnp.minimum((starts[:, None] + col).reshape(-1),
+                          jnp.uint32(n - 1))
+        masked = [
+            jnp.where(col_ok, lane[idx], pad[0])
+            for lane, pad in zip(sorted_lanes, pad_leaves)
+        ]
+    else:
+        padded_lanes = [
+            jnp.concatenate([lane, jnp.full((capacity,), pad[0], lane.dtype)])
+            for lane, pad in zip(sorted_lanes, pad_leaves)
+        ]
 
-    # Derive the init buffers from the input lanes (not fresh zeros) so their
-    # varying-manual-axes type matches inside shard_map bodies.
-    init = tuple(
-        jnp.zeros((num_blocks * capacity,), l.dtype) + l[0] * l.dtype.type(0)
-        for l in lanes)
-    outs = jax.lax.fori_loop(0, num_blocks, copy_block, init)
+        def copy_block(d, outs):
+            return tuple(
+                jax.lax.dynamic_update_slice(
+                    out,
+                    jax.lax.dynamic_slice(lane, (starts[d],), (capacity,)),
+                    (d * capacity,))
+                for out, lane in zip(outs, padded_lanes)
+            )
 
-    # Mask slots past each destination's count back to the pad value (covers
-    # both partial blocks and the slice overread into the next run).
-    col_ok = (jnp.arange(capacity, dtype=jnp.uint32)[None, :]
-              < jnp.minimum(counts, jnp.uint32(capacity))[:, None]).reshape(-1)
-    masked = [
-        jnp.where(col_ok, out, pad[0])
-        for out, pad in zip(outs, pad_leaves)
-    ]
+        # Derive the init buffers from the input lanes (not fresh zeros) so
+        # their varying-manual-axes type matches inside shard_map bodies.
+        init = tuple(
+            jnp.zeros((num_blocks * capacity,), l.dtype) + l[0] * l.dtype.type(0)
+            for l in lanes)
+        outs = jax.lax.fori_loop(0, num_blocks, copy_block, init)
+        # Mask slots past each destination's count back to the pad value
+        # (covers both partial blocks and slice overread into the next run).
+        masked = [
+            jnp.where(col_ok, out, pad[0])
+            for out, pad in zip(outs, pad_leaves)
+        ]
     blocks = jax.tree.unflatten(treedef, masked)
     overflow = jnp.sum(
         jnp.maximum(counts, jnp.uint32(capacity)) - jnp.uint32(capacity))
