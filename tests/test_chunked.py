@@ -103,3 +103,31 @@ def test_grid_checkpoint_resume(tmp_path):
         f.write("{trunca")
     assert chunked_join_grid(halves(r), halves(s), 1 << 10,
                              checkpoint_path=ckpt, checkpoint_tag="t") == total
+
+
+def test_grid_join_wide_streamed_chunks():
+    """A Relation(key_bits=64) stream through chunked_join_grid counts on
+    the full (hi, lo) key — the streaming/out-of-core path must not quietly
+    drop the hi lane the way round 2's driver path did."""
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.data.streaming import stream_chunks
+    from tpu_radix_join.ops.chunked import chunked_join_count, chunked_join_grid
+
+    r_rel = Relation(1 << 11, 1, "unique", seed=41, key_bits=64)
+    s_rel = Relation(1 << 11, 1, "modulo", modulo=1 << 10, seed=42,
+                     key_bits=64)
+    total = chunked_join_grid(
+        list(stream_chunks(r_rel, 0, 600)),
+        lambda: stream_chunks(s_rel, 0, 700),
+        slab_size=256)
+    # oracle: every modulo key < 2**10 matches exactly one unique key
+    assert total == s_rel.global_size
+
+    # mixed widths must raise, not truncate
+    import pytest
+    narrow = Relation(1 << 10, 1, "unique", seed=1)
+    wide = Relation(1 << 10, 1, "unique", seed=2, key_bits=64)
+    nb = next(iter(stream_chunks(narrow, 0, 1 << 10)))
+    wb = next(iter(stream_chunks(wide, 0, 1 << 10)))
+    with pytest.raises(ValueError, match="mixed key widths"):
+        chunked_join_count(wb, nb, 128)

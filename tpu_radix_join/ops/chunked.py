@@ -28,7 +28,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_radix_join.data.tuples import TupleBatch
-from tpu_radix_join.ops.merge_count import merge_count_chunks
+from tpu_radix_join.ops.merge_count import (
+    merge_count_chunks,
+    merge_count_wide_per_partition,
+)
 
 
 @functools.partial(jax.jit, static_argnames=("num_slabs",))
@@ -45,21 +48,51 @@ def _scan_probe(r_keys: jnp.ndarray, s_keys: jnp.ndarray, num_slabs: int):
     return per_slab
 
 
+@functools.partial(jax.jit, static_argnames=("num_slabs",))
+def _scan_probe_wide(r_lo, r_hi, s_lo, s_hi, num_slabs: int):
+    """Wide-key (hi/lo lane) twin of :func:`_scan_probe`."""
+    slabs = (s_lo.reshape(num_slabs, -1), s_hi.reshape(num_slabs, -1))
+
+    def step(carry, slab):
+        lo, hi = slab
+        c = merge_count_wide_per_partition(r_lo, r_hi, lo, hi, 0)
+        return carry, jnp.sum(c, dtype=jnp.uint32)
+
+    _, per_slab = jax.lax.scan(step, jnp.uint32(0), slabs)
+    return per_slab
+
+
 def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
     """Exact match count streaming the outer side in ``slab_size`` slabs.
 
     Ragged sizes (streamed chunks, short final chunks) are padded up to a
     slab multiple with the outer-side sentinel, which matches nothing by the
-    pad-key contract (tuples.py).
+    pad-key contract (tuples.py).  Wide (64-bit) batches — e.g. from a
+    ``Relation(key_bits=64)`` stream — take the hi/lo lexicographic count;
+    mixed-width inputs raise rather than silently truncate.
     """
     from tpu_radix_join.data.tuples import pad_sentinel
+    if (r.key_hi is None) != (s.key_hi is None):
+        raise ValueError(
+            "mixed key widths: one side carries a key_hi lane and the other "
+            "does not — refusing to run a silently-truncated join")
     keys = s.key
     n = keys.shape[0]
     pad = (-n) % slab_size
+    fill = pad_sentinel("outer")
     if pad:
         keys = jnp.concatenate(
-            [keys, jnp.full((pad,), pad_sentinel("outer"), keys.dtype)])
-    per_slab = _scan_probe(r.key, keys, (n + pad) // slab_size)
+            [keys, jnp.full((pad,), fill, keys.dtype)])
+    if r.key_hi is not None:
+        s_hi = s.key_hi
+        if pad:
+            # sentinel in BOTH lanes (the make_padding wide=True contract)
+            s_hi = jnp.concatenate(
+                [s_hi, jnp.full((pad,), fill, s_hi.dtype)])
+        per_slab = _scan_probe_wide(r.key, r.key_hi, keys, s_hi,
+                                    (n + pad) // slab_size)
+    else:
+        per_slab = _scan_probe(r.key, keys, (n + pad) // slab_size)
     return int(np.asarray(per_slab).astype(np.uint64).sum())
 
 
