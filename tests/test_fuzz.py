@@ -1,6 +1,6 @@
 """Randomized configuration sweep: joins with random geometry, policies,
-probe disciplines, and duplicate distributions must match the host numpy
-oracle exactly.  Seeded, so failures reproduce."""
+probe disciplines, key widths, and duplicate distributions must match the
+host numpy oracle exactly.  Seeded, so failures reproduce."""
 
 import numpy as np
 import pytest
@@ -8,7 +8,7 @@ import pytest
 from tpu_radix_join import HashJoin, JoinConfig, Relation
 from tpu_radix_join.data.relation import host_join_count
 
-CASES = list(range(10))
+CASES = list(range(14))
 
 
 def _random_case(case: int):
@@ -24,20 +24,47 @@ def _random_case(case: int):
     elif s_kind == "zipf":
         s_kw["zipf_theta"] = float(rng.uniform(0.2, 1.2))
         s_kw["key_domain"] = size
+    two_level = bool(rng.integers(0, 2))
+    fanout = int(rng.integers(2, 6))
+    window = str(rng.choice(["measured", "static"]))
+    # optional disciplines, respecting JoinConfig's combination rules
+    chunk = None
+    if not two_level and rng.random() < 0.3:
+        chunk = int(rng.choice([256, 1024]))
+    skew = None
+    if (not two_level and chunk is None and window == "measured"
+            and fanout <= 5 and rng.random() < 0.3):
+        skew = float(rng.uniform(1.5, 4.0))
+    key_bits = 64 if rng.random() < 0.3 else 32
     cfg = JoinConfig(
         num_nodes=nodes,
-        network_fanout_bits=int(rng.integers(2, 6)),
+        network_fanout_bits=fanout,
         local_fanout_bits=int(rng.integers(2, 5)),
-        two_level=bool(rng.integers(0, 2)),
+        two_level=two_level,
         assignment_policy=str(rng.choice(["round_robin", "load_aware"])),
-        window_sizing=str(rng.choice(["measured", "static"])),
+        window_sizing=window,
         allocation_factor=float(rng.uniform(2.0, 6.0)),
         max_retries=3,
+        chunk_size=chunk,
+        skew_threshold=skew,
+        key_bits=key_bits,
+        measure_phases=bool(rng.random() < 0.3),
     )
-    r = Relation(size, nodes, "unique", seed=int(rng.integers(1, 1 << 20)))
+    r = Relation(size, nodes, "unique", seed=int(rng.integers(1, 1 << 20)),
+                 key_bits=key_bits)
     s = Relation(size, nodes, s_kind, seed=int(rng.integers(1, 1 << 20)),
-                 **s_kw)
+                 key_bits=key_bits, **s_kw)
     return cfg, r, s
+
+
+def _host_keys(rel: Relation, nodes: int) -> np.ndarray:
+    """Full uint64 key array for the host oracle (wide keys composed)."""
+    shards = [rel.shard_np(i) for i in range(nodes)]
+    if rel.key_bits == 64:
+        return np.concatenate([
+            (hi.astype(np.uint64) << np.uint64(32)) | lo
+            for lo, hi, _ in shards])
+    return np.concatenate([lo for lo, _ in shards]).astype(np.uint64)
 
 
 @pytest.mark.parametrize("case", CASES)
@@ -45,6 +72,6 @@ def test_fuzz_against_host_oracle(case):
     cfg, r, s = _random_case(case)
     res = HashJoin(cfg).join(r, s)
     assert res.ok, (case, cfg, res.diagnostics)
-    rk = np.concatenate([r.shard_np(i)[0] for i in range(cfg.num_nodes)])
-    sk = np.concatenate([s.shard_np(i)[0] for i in range(cfg.num_nodes)])
+    rk = _host_keys(r, cfg.num_nodes)
+    sk = _host_keys(s, cfg.num_nodes)
     assert res.matches == host_join_count(rk, sk), (case, cfg)
