@@ -117,6 +117,11 @@ def main(argv=None) -> int:
     result = None
     for i in range(args.repeat):
         result = engine.join(inner, outer)
+    if args.repeat > 1:
+        # RESULTS accumulates per join; the report's "Tuples" line means THE
+        # join's result count.  Times/tuple counters stay cumulative (JRATE
+        # divides cumulative tuples by cumulative time — consistent).
+        meas.counters["RESULTS"] = result.matches
 
     # The reference's rank-0 aggregate report (Measurements.cpp:592-702):
     # multi-process worlds gather every rank's registry over the network
