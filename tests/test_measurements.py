@@ -100,6 +100,31 @@ def test_measure_phases_records_jmpi_and_jproc():
                                   res.partition_counts)
 
 
+def test_measure_phases_bucket_path_records_slocprep():
+    """On the two-level/bucket discipline the phase split is three programs:
+    shuffle (JMPI), local partitioning (SLOCPREP — the reference's
+    local-preparation column), build-probe (JPROC); results must equal the
+    fused pipeline's."""
+    import numpy as np
+    size = 1 << 12
+    r = Relation(size, 4, "unique", seed=3)
+    s = Relation(size, 4, "unique", seed=4)
+    base = dict(num_nodes=4, two_level=True, local_fanout_bits=3,
+                allocation_factor=3.0)
+    m = Measurements(num_nodes=4)
+    res = HashJoin(JoinConfig(**base, measure_phases=True),
+                   measurements=m).join(r, s)
+    assert res.ok and res.matches == size
+    for key in (M.JTOTAL, M.JHIST, M.JMPI, M.SLOCPREP, M.JPROC):
+        assert m.times_us[key] > 0, key
+    # derived histogram-rate tags exist once JHIST is recorded
+    assert m.counters[M.HILOCRATE] > 0
+    assert m.counters[M.HOLOCRATE] > 0
+    fused = HashJoin(JoinConfig(**base)).join(r, s)
+    np.testing.assert_array_equal(fused.partition_counts,
+                                  res.partition_counts)
+
+
 def test_measure_phases_skew_and_retry_mwinwait():
     """Phase-split execution composes with the skew split, and a retried
     (undersized) attempt's time lands in MWINWAIT, not JPROC."""

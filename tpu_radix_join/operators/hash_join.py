@@ -402,12 +402,15 @@ class HashJoin:
 
     def _run_split(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
                    local_slack: int, skew_plan):
-        """Execute one attempt as two programs (shuffle -> probe), recording
-        JMPI and JPROC from the host clock (the reference's per-phase columns;
-        the fused path can only time their sum).  Returns
-        (counts, flags ndarray, dt_mpi_us, dt_proc_us)."""
+        """Execute one attempt as separate phase programs, recording JMPI and
+        JPROC — plus SLOCPREP on the bucket path, where local partitioning
+        runs as its own program (the reference's LP/BP task columns,
+        Measurements.cpp:372-542) — from the host clock (the fused path can
+        only time their sum).  Returns
+        (counts, flags ndarray, dt_mpi_us, dt_lp_us, dt_proc_us)."""
         m = self.measurements
-        n = self.config.num_nodes
+        cfg = self.config
+        n = cfg.num_nodes
         base = (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
                 r.key_hi is None, s.key_hi is None,
                 getattr(r.key, "sharding", None),
@@ -421,19 +424,103 @@ class HashJoin:
         shuffled = fn_mpi(r, s)
         dt_mpi = m.stop("JMPI", fence=shuffled) if m else 0.0
         sflags = np.asarray(shuffled[5])
-        probe_args = tuple(shuffled[:5]) + tuple(shuffled[6:])
-        fn_proc = self._compile_timed(
-            ("proc", local_slack) + base,
-            lambda: self._probe_fn(cap_r, cap_s, local_slack, skew_plan
-                                   ).lower(*probe_args).compile())
-        if m:
-            m.start("JPROC")
-        counts, local_flag = fn_proc(*probe_args)
-        dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
+        dt_lp = 0.0
+        if cfg.two_level or cfg.probe_algorithm == "bucket":
+            # three-program chain: the second radix pass is its own program
+            # timed as SLOCPREP (skew/chunk can't combine with the bucket
+            # path — config-rejected — so the extra shuffle outputs are
+            # exactly the four the LP program consumes)
+            lp_args = tuple(shuffled[:4])
+            fn_lp = self._compile_timed(
+                ("lprep", local_slack) + base,
+                lambda: self._lp_fn(cap_r, cap_s, local_slack
+                                    ).lower(*lp_args).compile())
+            if m:
+                m.start("SLOCPREP")
+            lr_blocks, ls_blocks, local_flag = fn_lp(*lp_args)
+            dt_lp = (m.stop("SLOCPREP", fence=(lr_blocks, ls_blocks))
+                     if m else 0.0)
+            fn_bp = self._compile_timed(
+                ("bprobe", local_slack) + base,
+                lambda: self._bp_fn(cap_r, cap_s, local_slack
+                                    ).lower(lr_blocks, ls_blocks).compile())
+            if m:
+                m.start("JPROC")
+            counts = fn_bp(lr_blocks, ls_blocks)
+            dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
+        else:
+            probe_args = tuple(shuffled[:5]) + tuple(shuffled[6:])
+            fn_proc = self._compile_timed(
+                ("proc", local_slack) + base,
+                lambda: self._probe_fn(cap_r, cap_s, local_slack, skew_plan
+                                       ).lower(*probe_args).compile())
+            if m:
+                m.start("JPROC")
+            counts, local_flag = fn_proc(*probe_args)
+            dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
         flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
                           int(np.asarray(local_flag)), sflags[4]],
                          dtype=np.uint32)
-        return counts, flags, dt_mpi, dt_proc
+        return counts, flags, dt_mpi, dt_lp, dt_proc
+
+    def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int):
+        """Per-bucket capacities of the second radix pass."""
+        cfg = self.config
+        n = cfg.num_nodes
+        nb = cfg.local_partition_count
+        return (cfg.bucket_capacity(n * cap_r, nb) * local_slack,
+                cfg.bucket_capacity(n * cap_s, nb) * local_slack)
+
+    def _bucket_probe(self, lr_blocks: TupleBatch, ls_blocks: TupleBatch,
+                      lcap_r: int, lcap_s: int):
+        """Per-bucket counting over capacity-padded bucket blocks; wide keys'
+        hi lanes ride the same blocks and the probe's three-key batched row
+        sort compares full (hi, lo) pairs."""
+        nb = self.config.local_partition_count
+        return probe_count_bucketized(
+            lr_blocks.key.reshape(nb, lcap_r),
+            ls_blocks.key.reshape(nb, lcap_s),
+            None if lr_blocks.key_hi is None
+            else lr_blocks.key_hi.reshape(nb, lcap_r),
+            None if ls_blocks.key_hi is None
+            else ls_blocks.key_hi.reshape(nb, lcap_s))
+
+    def _lp_fn(self, cap_r: int, cap_s: int, local_slack: int):
+        """Local-partitioning program of the bucket-path phase split:
+        SLOCPREP, the reference's local-preparation column
+        (Measurements.cpp:176-178; LocalPartitioning task time)."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        fanout = cfg.network_fanout_bits
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
+
+        def body(rp_batch, rp_valid, sp_batch, sp_valid):
+            lr = local_partition(rp_batch, rp_valid, fanout,
+                                 cfg.local_fanout_bits, lcap_r, "inner")
+            ls = local_partition(sp_batch, sp_valid, fanout,
+                                 cfg.local_fanout_bits, lcap_s, "outer")
+            ovf = jax.lax.psum(
+                (lr.overflow + ls.overflow).astype(jnp.uint32), ax)
+            return lr.blocks, ls.blocks, ovf
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec, spec, spec),
+            out_specs=(spec, spec, P())))
+
+    def _bp_fn(self, cap_r: int, cap_s: int, local_slack: int):
+        """Build-probe program of the bucket-path phase split (JPROC: the
+        BuildProbe task time, Measurements.cpp:471-542)."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
+
+        def body(lr_blocks, ls_blocks):
+            return self._bucket_probe(lr_blocks, ls_blocks, lcap_r, lcap_s)
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=spec))
 
     def _local_process(self, rp_batch: TupleBatch, rp_valid, sp_batch: TupleBatch,
                        sp_valid, sp_pid, hot_batch, cap_r: int, cap_s: int,
@@ -444,26 +531,16 @@ class HashJoin:
         JMPI/JPROC separately (``config.measure_phases``).  Returns
         (per-partition counts, local overflow)."""
         cfg = self.config
-        n = cfg.num_nodes
         fanout = cfg.network_fanout_bits
         num_p = cfg.network_partition_count
         wide = rp_batch.key_hi is not None
         if cfg.two_level or cfg.probe_algorithm == "bucket":
-            nb = cfg.local_partition_count
-            lcap_r = cfg.bucket_capacity(n * cap_r, nb) * local_slack
-            lcap_s = cfg.bucket_capacity(n * cap_s, nb) * local_slack
+            lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
             lr = local_partition(rp_batch, rp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_r, "inner")
             ls = local_partition(sp_batch, sp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_s, "outer")
-            # wide keys: hi lanes ride the same blocks; the probe's
-            # three-key batched row sort compares full (hi, lo) pairs
-            counts = probe_count_bucketized(
-                lr.blocks.key.reshape(nb, lcap_r),
-                ls.blocks.key.reshape(nb, lcap_s),
-                None if not wide else lr.blocks.key_hi.reshape(nb, lcap_r),
-                None if sp_batch.key_hi is None
-                else ls.blocks.key_hi.reshape(nb, lcap_s))
+            counts = self._bucket_probe(lr.blocks, ls.blocks, lcap_r, lcap_s)
             local_overflow = lr.overflow + ls.overflow
         elif cfg.chunk_size:
             # out-of-core discipline (LD kernels): outer slabs under scan
@@ -765,7 +842,7 @@ class HashJoin:
                      and not self._single_node_sort_probe())
         for attempt in range(self.config.max_retries + 1):
             if use_split:
-                counts, flags, dt_mpi, dt_proc = self._run_split(
+                counts, flags, dt_mpi, dt_lp, dt_proc = self._run_split(
                     r, s, cap_r, cap_s, local_slack, skew_plan)
             else:
                 fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
@@ -773,7 +850,7 @@ class HashJoin:
                 if m:
                     m.start("JPROC")
                 counts, flags = fn(r, s)
-                dt_mpi = 0.0
+                dt_mpi = dt_lp = 0.0
                 dt_proc = (m.stop("JPROC", fence=(counts, flags))
                            if m else 0.0)
                 flags = np.asarray(flags)
@@ -797,9 +874,11 @@ class HashJoin:
                 # the attempt that produced the result.  When retries are
                 # exhausted the last attempt IS the result — keep its time.
                 m.incr("RETRIES")
-                m.add_time_us("MWINWAIT", dt_mpi + dt_proc)
+                m.add_time_us("MWINWAIT", dt_mpi + dt_lp + dt_proc)
                 if dt_proc:
                     m.times_us["JPROC"] -= dt_proc
+                if dt_lp:
+                    m.times_us["SLOCPREP"] -= dt_lp
                 if dt_mpi:
                     m.times_us["JMPI"] -= dt_mpi
         counts = self._to_host(counts)

@@ -56,6 +56,8 @@ WINCAPR = "WINCAPR"        # per-(sender,dest) block capacity, inner window
 WINCAPS = "WINCAPS"        # per-(sender,dest) block capacity, outer window
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
+HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second
+HOLOCRATE = "HOLOCRATE"    # derived: outer tuples / JHIST second
 
 
 class Measurements:
@@ -123,6 +125,15 @@ class Measurements:
             us = self.times_us.get(time_key, 0.0)
             if tuples and us > 0:
                 self.counters[rate_key] = int(tuples / (us / 1e6))
+        # histogram scan rates, tuples/s per side (the reference reports MB/s
+        # over the same quantities, Measurements.cpp:251-260)
+        jh = self.times_us.get(JHIST, 0.0)
+        if jh > 0:
+            for rate_key, cnt_key in ((HILOCRATE, RTUPLES),
+                                      (HOLOCRATE, STUPLES)):
+                cnt = self.counters.get(cnt_key, 0)
+                if cnt:
+                    self.counters[rate_key] = int(cnt / (jh / 1e6))
 
     # ------------------------------------------------------- memory / tracing
     def memory_utilization(self) -> Dict[str, int]:
