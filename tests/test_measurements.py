@@ -93,6 +93,8 @@ def test_measure_phases_records_jmpi_and_jproc():
     assert res.ok and res.matches == size
     for key in (M.JTOTAL, M.JHIST, M.JMPI, M.JPROC):
         assert m.times_us[key] > 0, key
+    # the completion-wait component of JMPI (the fence) is SNETCOMPL
+    assert 0 < m.times_us[M.SNETCOMPL] <= m.times_us[M.JMPI]
     fused = HashJoin(JoinConfig(num_nodes=4)).join(r, s)
     assert fused.matches == res.matches
     import numpy as np

@@ -422,6 +422,14 @@ class HashJoin:
         if m:
             m.start("JMPI")
         shuffled = fn_mpi(r, s)
+        if m:
+            # the dispatch has returned but the exchange may still be in
+            # flight; the fence wait is the network-completion barrier —
+            # SNETCOMPL (Measurements.cpp:176-178, Window completion wait).
+            # JMPI spans dispatch + completion, as the reference's network
+            # phase spans Puts + the flush barrier.
+            m.start("SNETCOMPL")
+            m.stop("SNETCOMPL", fence=shuffled)
         dt_mpi = m.stop("JMPI", fence=shuffled) if m else 0.0
         sflags = np.asarray(shuffled[5])
         dt_lp = 0.0
