@@ -39,6 +39,32 @@ def _time_amortized(fn, args, iters=20):
 
 
 def main():
+    # A downed axon tunnel makes jax.devices() block on a *native* futex that
+    # a SIGALRM Python handler can never interrupt; probe the backend in a
+    # child process with a hard timeout so the bench fails fast and loud
+    # instead of hanging the driver forever.
+    import subprocess
+    try:
+        # sitecustomize locks the platform default at import, so the child
+        # re-applies any JAX_PLATFORMS override the same way the parent must
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import os, jax\n"
+             "p = os.environ.get('JAX_PLATFORMS')\n"
+             "p and jax.config.update('jax_platforms', p)\n"
+             "print(jax.devices()[0])"],
+            capture_output=True, text=True, timeout=120)
+    except subprocess.TimeoutExpired:
+        print("ERROR: device backend did not come up within 120s — the TPU "
+              "tunnel hangs rather than failing when it is down; aborting",
+              file=sys.stderr)
+        sys.exit(2)
+    if probe.returncode != 0:
+        print(f"ERROR: device backend unavailable:\n{probe.stderr.strip()}",
+              file=sys.stderr)
+        sys.exit(2)
+    print(f"note: device: {probe.stdout.strip()}", file=sys.stderr)
+
     import jax
     import jax.numpy as jnp
     from tpu_radix_join.data.relation import Relation

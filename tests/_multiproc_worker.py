@@ -30,7 +30,9 @@ def main(port: str, rank: str, nproc: str) -> None:
     from tpu_radix_join.performance import Measurements, print_results
 
     n = jax.device_count()
-    cfg = JoinConfig(num_nodes=n, num_hosts=nproc)
+    # measure_phases: the shuffle (JMPI, with cross-process collectives) and
+    # the probe run as separate programs even in a real multi-process world
+    cfg = JoinConfig(num_nodes=n, num_hosts=nproc, measure_phases=True)
     size = 1 << 12
     r = Relation(size, n, "unique", seed=1)
     s = Relation(size, n, "unique", seed=9)
@@ -38,6 +40,7 @@ def main(port: str, rank: str, nproc: str) -> None:
     res = HashJoin(cfg, measurements=m).join(r, s)
     assert res.ok, res.diagnostics
     assert res.matches == size, res.matches
+    assert m.times_us.get("JMPI", 0) > 0 and m.times_us.get("JPROC", 0) > 0
 
     all_m = m.gather_all()
     assert len(all_m) == nproc, len(all_m)

@@ -148,19 +148,26 @@ def test_measure_phases_skew_and_retry_mwinwait():
     res = HashJoin(cfg, measurements=m).join_arrays(r, s)
     assert res.ok and res.matches == size
     assert m.times_us[M.JMPI] > 0 and m.times_us[M.JPROC] > 0
-    # retry accounting: force a shortfall via static undersized windows
-    m2 = Measurements(num_nodes=4)
-    cfg2 = JoinConfig(num_nodes=4, window_sizing="static",
-                      allocation_factor=1.0, max_retries=3)
+    # retry accounting: force a shortfall via static undersized windows,
+    # through BOTH execution modes
     zr = TupleBatch(key=jnp.zeros(1 << 10, jnp.uint32),   # all partition 0
                     rid=jnp.arange(1 << 10, dtype=jnp.uint32))
     su = TupleBatch(key=jnp.arange(1 << 10, dtype=jnp.uint32),
                     rid=jnp.arange(1 << 10, dtype=jnp.uint32))
-    res2 = HashJoin(cfg2, measurements=m2).join_arrays(zr, su)
-    assert res2.ok
-    assert m2.counters["RETRIES"] >= 1
-    assert m2.times_us[M.MWINWAIT] > 0
-    assert m2.times_us[M.JPROC] > 0
+    for phases in (False, True):
+        m2 = Measurements(num_nodes=4)
+        cfg2 = JoinConfig(num_nodes=4, window_sizing="static",
+                          allocation_factor=1.0, max_retries=3,
+                          measure_phases=phases)
+        res2 = HashJoin(cfg2, measurements=m2).join_arrays(zr, su)
+        assert res2.ok
+        assert m2.counters["RETRIES"] >= 1
+        assert m2.times_us[M.MWINWAIT] > 0
+        assert m2.times_us[M.JPROC] > 0
+        if phases:
+            # superseded attempts roll every phase column back, including
+            # the JMPI-nested completion wait
+            assert 0 < m2.times_us[M.SNETCOMPL] <= m2.times_us[M.JMPI]
 
 
 def test_load_skips_stray_perf_files(tmp_path):

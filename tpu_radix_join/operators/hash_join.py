@@ -406,8 +406,8 @@ class HashJoin:
         JPROC — plus SLOCPREP on the bucket path, where local partitioning
         runs as its own program (the reference's LP/BP task columns,
         Measurements.cpp:372-542) — from the host clock (the fused path can
-        only time their sum).  Returns
-        (counts, flags ndarray, dt_mpi_us, dt_lp_us, dt_proc_us)."""
+        only time their sum).  Returns (counts, flags ndarray, phase-dt dict
+        keyed by registry tag; SNETCOMPL is nested inside JMPI)."""
         m = self.measurements
         cfg = self.config
         n = cfg.num_nodes
@@ -419,6 +419,7 @@ class HashJoin:
             ("mpi",) + base,
             lambda: self._shuffle_fn(cap_r, cap_s,
                                      skew_plan).lower(r, s).compile())
+        dts = {}
         if m:
             m.start("JMPI")
         shuffled = fn_mpi(r, s)
@@ -429,10 +430,9 @@ class HashJoin:
             # JMPI spans dispatch + completion, as the reference's network
             # phase spans Puts + the flush barrier.
             m.start("SNETCOMPL")
-            m.stop("SNETCOMPL", fence=shuffled)
-        dt_mpi = m.stop("JMPI", fence=shuffled) if m else 0.0
+            dts["SNETCOMPL"] = m.stop("SNETCOMPL", fence=shuffled)
+            dts["JMPI"] = m.stop("JMPI", fence=shuffled)
         sflags = np.asarray(shuffled[5])
-        dt_lp = 0.0
         if cfg.two_level or cfg.probe_algorithm == "bucket":
             # three-program chain: the second radix pass is its own program
             # timed as SLOCPREP (skew/chunk can't combine with the bucket
@@ -446,8 +446,9 @@ class HashJoin:
             if m:
                 m.start("SLOCPREP")
             lr_blocks, ls_blocks, local_flag = fn_lp(*lp_args)
-            dt_lp = (m.stop("SLOCPREP", fence=(lr_blocks, ls_blocks))
-                     if m else 0.0)
+            if m:
+                dts["SLOCPREP"] = m.stop("SLOCPREP",
+                                         fence=(lr_blocks, ls_blocks))
             fn_bp = self._compile_timed(
                 ("bprobe", local_slack) + base,
                 lambda: self._bp_fn(cap_r, cap_s, local_slack
@@ -455,7 +456,8 @@ class HashJoin:
             if m:
                 m.start("JPROC")
             counts = fn_bp(lr_blocks, ls_blocks)
-            dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
+            if m:
+                dts["JPROC"] = m.stop("JPROC", fence=counts)
         else:
             probe_args = tuple(shuffled[:5]) + tuple(shuffled[6:])
             fn_proc = self._compile_timed(
@@ -465,11 +467,12 @@ class HashJoin:
             if m:
                 m.start("JPROC")
             counts, local_flag = fn_proc(*probe_args)
-            dt_proc = m.stop("JPROC", fence=counts) if m else 0.0
+            if m:
+                dts["JPROC"] = m.stop("JPROC", fence=counts)
         flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
                           int(np.asarray(local_flag)), sflags[4]],
                          dtype=np.uint32)
-        return counts, flags, dt_mpi, dt_lp, dt_proc
+        return counts, flags, dts
 
     def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int):
         """Per-bucket capacities of the second radix pass."""
@@ -850,7 +853,7 @@ class HashJoin:
                      and not self._single_node_sort_probe())
         for attempt in range(self.config.max_retries + 1):
             if use_split:
-                counts, flags, dt_mpi, dt_lp, dt_proc = self._run_split(
+                counts, flags, dts = self._run_split(
                     r, s, cap_r, cap_s, local_slack, skew_plan)
             else:
                 fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
@@ -858,9 +861,8 @@ class HashJoin:
                 if m:
                     m.start("JPROC")
                 counts, flags = fn(r, s)
-                dt_mpi = dt_lp = 0.0
-                dt_proc = (m.stop("JPROC", fence=(counts, flags))
-                           if m else 0.0)
+                dts = ({"JPROC": m.stop("JPROC", fence=(counts, flags))}
+                       if m else {})
                 flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
@@ -878,17 +880,19 @@ class HashJoin:
             if m and attempt < self.config.max_retries:
                 # A superseded attempt's device time is window-wait, not join
                 # work: reclassify it as MWINWAIT (the reference's stall
-                # column, Measurements.cpp:272-349) so JMPI/JPROC report only
-                # the attempt that produced the result.  When retries are
-                # exhausted the last attempt IS the result — keep its time.
+                # column, Measurements.cpp:272-349) so the phase columns
+                # report only the attempt that produced the result.  When
+                # retries are exhausted the last attempt IS the result —
+                # keep its time.  SNETCOMPL is nested inside JMPI, so it is
+                # rolled back from its own key but not double-added to
+                # MWINWAIT.
                 m.incr("RETRIES")
-                m.add_time_us("MWINWAIT", dt_mpi + dt_lp + dt_proc)
-                if dt_proc:
-                    m.times_us["JPROC"] -= dt_proc
-                if dt_lp:
-                    m.times_us["SLOCPREP"] -= dt_lp
-                if dt_mpi:
-                    m.times_us["JMPI"] -= dt_mpi
+                m.add_time_us("MWINWAIT",
+                              sum(v for k, v in dts.items()
+                                  if k != "SNETCOMPL"))
+                for k, v in dts.items():
+                    if v:
+                        m.times_us[k] -= v
         counts = self._to_host(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
