@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-3 chip-gated task runner: waits for the axon tunnel, then runs the
+# experiments and canonical-workload artifacts in sequence.  Outputs under
+# artifacts/chip_r3/.
+set -u
+cd /root/repo
+OUT=artifacts/chip_r3
+mkdir -p "$OUT"
+
+probe() { timeout 45 python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; }
+
+echo "$(date -u +%H:%M:%S) waiting for TPU tunnel..."
+for i in $(seq 1 200); do
+  if probe; then echo "$(date -u +%H:%M:%S) tunnel up"; break; fi
+  sleep 90
+  if [ "$i" = 200 ]; then echo "tunnel never came back"; exit 3; fi
+done
+
+run() {
+  name=$1; shift
+  echo "=== $name: $* ==="
+  timeout 2400 "$@" > "$OUT/$name.log" 2>&1
+  echo "$name rc=$? ($(date -u +%H:%M:%S))"
+}
+
+run scatter python experiments/exp_block_scatter.py
+run bench python bench.py
+SIXTEEN=$((1<<24))
+run cli_16m_sort python -m tpu_radix_join.main --tuples-per-node $SIXTEEN \
+    --nodes 1 --repeat 3 --output-dir "$OUT/perf_16m_sort"
+run cli_16m_phases python -m tpu_radix_join.main --tuples-per-node $SIXTEEN \
+    --nodes 1 --two-level --measure-phases --repeat 3 \
+    --output-dir "$OUT/perf_16m_phases"
+run cli_20m_sort python -m tpu_radix_join.main --tuples-per-node 20000000 \
+    --nodes 1 --repeat 3 --output-dir "$OUT/perf_20m_sort"
+run cli_20m_phases python -m tpu_radix_join.main --tuples-per-node 20000000 \
+    --nodes 1 --two-level --measure-phases --repeat 3 \
+    --output-dir "$OUT/perf_20m_phases"
+echo "ALL_CHIP_TASKS_DONE $(date -u +%H:%M:%S)"
