@@ -42,6 +42,13 @@ def main(port: str, rank: str, nproc: str) -> None:
     assert res.matches == size, res.matches
     assert m.times_us.get("JMPI", 0) > 0 and m.times_us.get("JPROC", 0) > 0
 
+    # materializing pipeline across processes: exercises the single-
+    # collective stacked result gather (hash_join.join_materialize_arrays)
+    mat = HashJoin(JoinConfig(num_nodes=n, num_hosts=nproc,
+                              match_rate_cap=4)).join_materialize(r, s)
+    assert mat.ok, mat.diagnostics
+    assert mat.matches == size, mat.matches
+
     all_m = m.gather_all()
     assert len(all_m) == nproc, len(all_m)
     assert sorted(mm.node_id for mm in all_m) == list(range(nproc))

@@ -135,6 +135,26 @@ def test_build_hot_partition_not_split():
     assert hot2[5] and hot2.sum() == 1
 
 
+def test_tiny_build_side_does_not_veto_split():
+    """An absolutely tiny but relatively elevated R must not veto spreading
+    a massively probe-hot partition: with num_nodes given, affordability is
+    also judged by replication cost vs probe work (n*R <= S)."""
+    r = np.full(32, 20, np.uint64)
+    r[5] = 100               # ~4.4x the R mean, but only 100 tuples
+    s = np.full(32, 100, np.uint64)
+    s[5] = 1_000_000
+    # without the absolute clause the relative R guard vetoes
+    assert not skew.detect_hot_partitions(r, s, 4.0).any()
+    hot = skew.detect_hot_partitions(r, s, 4.0, num_nodes=8)
+    assert hot[5] and hot.sum() == 1
+    # a genuinely build-heavy partition still stays single-owner
+    r2 = np.full(32, 20, np.uint64)
+    r2[5] = 1_000_000
+    s2 = np.full(32, 100, np.uint64)
+    s2[5] = 1_000_000
+    assert not skew.detect_hot_partitions(r2, s2, 4.0, num_nodes=8).any()
+
+
 def test_zipf_skew_split_end_to_end():
     n, size = 8, 1 << 14
     cfg = JoinConfig(num_nodes=n, skew_threshold=3.0,

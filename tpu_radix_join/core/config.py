@@ -135,6 +135,20 @@ class JoinConfig:
 
     # --- derived geometry ------------------------------------------------------
     @property
+    def sort_probe(self) -> bool:
+        """True when the (chunk-free) flat sort-merge probe discipline is
+        active — the predicate that selects the 31-bit merge-count packing
+        (ops/merge_count.MAX_MERGE_KEY) as the key-range contract."""
+        return (not self.two_level and self.probe_algorithm != "bucket"
+                and not self.chunk_size)
+
+    @property
+    def bucket_path(self) -> bool:
+        """True when local processing goes through the second radix pass +
+        bucketized probe (two-level discipline)."""
+        return self.two_level or self.probe_algorithm == "bucket"
+
+    @property
     def mesh_axes(self):
         """Axis name(s) the pipeline's collectives run over: the flat
         ``mesh_axis`` string, or the ``("dcn", "ici")`` pair when the mesh is

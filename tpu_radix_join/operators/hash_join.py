@@ -173,9 +173,7 @@ class HashJoin:
         Violations flip ``ok`` rather than silently overcounting against
         padding slots."""
         cfg = self.config
-        sort_probe = (not cfg.two_level and cfg.probe_algorithm != "bucket"
-                      and not cfg.chunk_size)
-        uses_merge = r.key_hi is None and sort_probe
+        uses_merge = r.key_hi is None and cfg.sort_probe
         key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
         return (jnp.max(_sentinel_lane(r)) < key_cap) & (
             jnp.max(_sentinel_lane(s)) < key_cap)
@@ -185,8 +183,7 @@ class HashJoin:
         no windows): the sizing pre-pass would compute capacities nothing
         reads, so the driver skips it and uses a fixed dummy capacity."""
         cfg = self.config
-        return (cfg.num_nodes == 1 and not cfg.two_level
-                and cfg.probe_algorithm != "bucket" and not cfg.chunk_size)
+        return cfg.num_nodes == 1 and cfg.sort_probe
 
     def _measure_capacities(self, r: TupleBatch, s: TupleBatch,
                             shuffles: bool = True):
@@ -221,7 +218,8 @@ class HashJoin:
         skew_plan = None
         if cfg.skew_threshold is not None and n > 1:
             hot = skew.detect_hot_partitions(
-                np.asarray(r_gh), np.asarray(s_gh), cfg.skew_threshold)
+                np.asarray(r_gh), np.asarray(s_gh), cfg.skew_threshold,
+                num_nodes=n)
             if hot.any():
                 hot_bits = skew.hot_mask_bits(hot)
                 r_demand, s_demand, _, _, hot_counts = self._run_hist(
@@ -277,12 +275,9 @@ class HashJoin:
         win_s = Window(n, cap_s, ax, "outer")
 
         def body(r: TupleBatch, s: TupleBatch):
-            sort_probe = (not cfg.two_level
-                          and cfg.probe_algorithm != "bucket"
-                          and not cfg.chunk_size)
             keys_ok = self._keys_in_contract(r, s)
 
-            if n == 1 and sort_probe:
+            if n == 1 and cfg.sort_probe:
                 # Single-node specialization: the all_to_all is an identity
                 # and the sort-merge probe needs no pre-partitioned input
                 # (the reference runs NetworkPartitioning even at 1 node,
@@ -433,7 +428,7 @@ class HashJoin:
             dts["SNETCOMPL"] = m.stop("SNETCOMPL", fence=shuffled)
             dts["JMPI"] = m.stop("JMPI", fence=shuffled)
         sflags = np.asarray(shuffled[5])
-        if cfg.two_level or cfg.probe_algorithm == "bucket":
+        if cfg.bucket_path:
             # three-program chain: the second radix pass is its own program
             # timed as SLOCPREP (skew/chunk can't combine with the bucket
             # path — config-rejected — so the extra shuffle outputs are
@@ -545,7 +540,7 @@ class HashJoin:
         fanout = cfg.network_fanout_bits
         num_p = cfg.network_partition_count
         wide = rp_batch.key_hi is not None
-        if cfg.two_level or cfg.probe_algorithm == "bucket":
+        if cfg.bucket_path:
             lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
             lr = local_partition(rp_batch, rp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_r, "inner")
@@ -955,9 +950,18 @@ class HashJoin:
                 m.incr("RETRIES")
                 m.add_time_us("MWINWAIT", dt_proc)
                 m.times_us["JPROC"] -= dt_proc
-        valid = self._to_host(valid)
-        r_rid = self._to_host(r_rid)[valid]
-        s_rid = self._to_host(s_rid)[valid]
+        if getattr(valid, "is_fully_addressable", True):
+            valid = np.asarray(valid)
+            r_rid = np.asarray(r_rid)[valid]
+            s_rid = np.asarray(s_rid)[valid]
+        else:
+            # multi-process: ONE collective for all three lanes instead of
+            # three sequential full-buffer allgathers of mostly-padding rows
+            stacked = self._to_host(jnp.stack(
+                [r_rid, s_rid, valid.astype(jnp.uint32)]))
+            valid = stacked[2].astype(bool)
+            r_rid = stacked[0][valid]
+            s_rid = stacked[1][valid]
         if m:
             m.stop("JTOTAL")
             m.incr("RESULTS", int(valid.sum()))

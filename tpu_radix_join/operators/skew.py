@@ -41,7 +41,8 @@ MAX_SKEW_PARTITIONS = 32
 
 
 def detect_hot_partitions(r_ghist: np.ndarray, s_ghist: np.ndarray,
-                          threshold: float) -> np.ndarray:
+                          threshold: float,
+                          num_nodes: int = 0) -> np.ndarray:
     """bool [P]: partitions worth splitting (skew_detect's
     blocks-per-partition criterion, kernels_optimized.cu:301-311, reduced to
     a binary split/don't-split decision).
@@ -49,14 +50,21 @@ def detect_hot_partitions(r_ghist: np.ndarray, s_ghist: np.ndarray,
     The split replicates the partition's entire R to every device and spreads
     its S, so it pays off exactly when the *probe* side dominates: detection
     requires (a) the S weight alone to exceed ``threshold`` x the mean total
-    partition weight, and (b) the R side not to be hot itself (its weight
-    within ``threshold`` x the mean R weight) — a build-heavy partition would
-    cost n-fold memory/ICI to replicate precisely where R is largest, worse
-    than leaving it owned by one node (ADVICE r2)."""
+    partition weight, and (b) the replication to be affordable — either the
+    R side is not itself hot (within ``threshold`` x the mean R weight), or,
+    when ``num_nodes`` is given, the replication cost is dominated by the
+    probe work being spread (``num_nodes * R[p] <= S[p]``).  The absolute
+    clause matters for small build sides, where a relatively elevated but
+    absolutely tiny R must not veto spreading millions of probe tuples; a
+    genuinely build-heavy partition still stays single-owner (n-fold
+    memory/ICI to replicate precisely where R is largest — ADVICE r2)."""
     r = r_ghist.astype(np.float64)
     s = s_ghist.astype(np.float64)
     w = r + s
-    return (s > threshold * w.mean()) & (r <= threshold * max(r.mean(), 1.0))
+    affordable = r <= threshold * max(r.mean(), 1.0)
+    if num_nodes > 0:
+        affordable |= (num_nodes * r) <= s
+    return (s > threshold * w.mean()) & affordable
 
 
 def hot_mask_bits(hot: np.ndarray) -> int:

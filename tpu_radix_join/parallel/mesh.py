@@ -72,13 +72,22 @@ def make_hierarchical_mesh(
                 (1, per_host), (num_hosts, 1), devices=devs[:n])
         except ValueError:
             # no slice topology (e.g. multi-process virtual CPU devices):
-            # group rows by owning process — valid only when hosts and
-            # processes coincide, else the "dcn" axis would not cross
-            # process boundaries and the misconfiguration must surface
+            # group rows by owning process — valid only when the resulting
+            # rows are process-homogeneous and each row is a distinct
+            # process, else the "dcn" axis would not cross process
+            # boundaries and the misconfiguration must surface
             if num_hosts != jax.process_count():
                 raise
             ordered = sorted(devs[:n], key=lambda d: (d.process_index, d.id))
             grid = np.asarray(ordered).reshape(num_hosts, per_host)
+            row_procs = [{d.process_index for d in row} for row in grid]
+            if (any(len(p) != 1 for p in row_procs)
+                    or len(set().union(*row_procs)) != num_hosts):
+                raise ValueError(
+                    f"cannot build a process-aligned hierarchical mesh from "
+                    f"the first {n} of {len(devs)} devices: rows would not "
+                    f"each map to one distinct process — use num_nodes "
+                    f"spanning all processes' devices")
     else:
         grid = np.asarray(devs[:n]).reshape(num_hosts, per_host)
     return Mesh(grid, tuple(axis))

@@ -205,18 +205,24 @@ class Measurements:
             return [self]
         import numpy as np
         from jax.experimental import multihost_utils
-        payload = json.dumps({
+        rec = {
             "node": self.node_id,
             "num_nodes": self.num_nodes,
             "times_us": self.times_us,
             "counters": self.counters,
             "meta": self.meta,
-        }, default=str).encode()
+        }
+        payload = json.dumps(rec, default=str).encode()
         cap = _GATHER_BUF_BYTES - 4
+        if len(payload) > cap:
+            # never fail the report of an already-successful join over
+            # oversized metadata: drop meta first, keep the measurements
+            rec["meta"] = {"truncated": True}
+            payload = json.dumps(rec, default=str).encode()
         if len(payload) > cap:
             raise ValueError(
                 f"measurement payload ({len(payload)}B) exceeds the "
-                f"{cap}B gather buffer")
+                f"{cap}B gather buffer even without meta")
         buf = np.zeros(_GATHER_BUF_BYTES, np.uint8)
         buf[:4] = np.frombuffer(
             np.uint32(len(payload)).tobytes(), dtype=np.uint8)
