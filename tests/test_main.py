@@ -39,3 +39,20 @@ def test_cli_new_flags(capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "Conservation: OK" in out
+
+
+def test_cli_measure_phases(capsys):
+    rc = main(["--tuples-per-node", "2048", "--nodes", "4",
+               "--measure-phases"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    for tag in ("JHIST", "JMPI", "JPROC", "SNETCOMPL"):
+        assert tag in out, tag
+
+
+def test_cli_repeat_reports_single_join_tuples(capsys):
+    rc = main(["--tuples-per-node", "1024", "--nodes", "2", "--repeat", "3"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[RESULTS] Tuples: 2048" in out
+    assert "Tuples: 6144" not in out
