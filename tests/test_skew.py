@@ -155,6 +155,21 @@ def test_tiny_build_side_does_not_veto_split():
     assert not skew.detect_hot_partitions(r2, s2, 4.0, num_nodes=8).any()
 
 
+def test_hot_split_on_hierarchical_mesh():
+    """The split routing (replicate / hashed spread) composes with the
+    two-stage (dcn, ici) exchange: exact counts and clean diagnostics on a
+    2-host x 4-device mesh."""
+    n, size = 8, 1 << 14
+    r, s = _hot_workload(size)
+    cfg = JoinConfig(num_nodes=n, num_hosts=2, skew_threshold=4.0,
+                     max_retries=1)
+    res = HashJoin(cfg).join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+    pc = res.partition_counts.reshape(n, 32)
+    assert (pc[:, 3] > 0).all()       # hot work on every device
+
+
 def test_zipf_skew_split_end_to_end():
     n, size = 8, 1 << 14
     cfg = JoinConfig(num_nodes=n, skew_threshold=3.0,
