@@ -170,6 +170,24 @@ def test_measure_phases_skew_and_retry_mwinwait():
             assert 0 < m2.times_us[M.SNETCOMPL] <= m2.times_us[M.JMPI]
 
 
+def test_measure_phases_materialize():
+    """join_materialize honors measure_phases: shuffle (JMPI+SNETCOMPL) and
+    the rid-pair probe (JPROC) as two programs; identical pairs to fused."""
+    size = 1 << 12
+    r = Relation(size, 4, "unique", seed=5)
+    s = Relation(size, 4, "modulo", modulo=size // 2, seed=6)
+    base = dict(num_nodes=4, match_rate_cap=4)
+    m = Measurements(num_nodes=4)
+    split = HashJoin(JoinConfig(**base, measure_phases=True),
+                     measurements=m).join_materialize(r, s)
+    assert split.ok and split.matches == size
+    for key in (M.JMPI, M.SNETCOMPL, M.JPROC):
+        assert m.times_us[key] > 0, key
+    fused = HashJoin(JoinConfig(**base)).join_materialize(r, s)
+    assert (set(zip(split.r_rid.tolist(), split.s_rid.tolist()))
+            == set(zip(fused.r_rid.tolist(), fused.s_rid.tolist())))
+
+
 def test_load_skips_stray_perf_files(tmp_path):
     m = Measurements(node_id=0)
     m.times_us[M.JTOTAL] = 5.0

@@ -166,17 +166,45 @@ class HashJoin:
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=(spec, spec, P(), P(), spec)))
 
-    def _keys_in_contract(self, r: TupleBatch, s: TupleBatch) -> jnp.ndarray:
+    def _keys_in_contract(self, r: TupleBatch, s: TupleBatch,
+                          materialize: bool = False) -> jnp.ndarray:
         """Input contract check (traced): real keys must stay below the
         padding sentinels (tuples.py) — and below the 31-bit merge-count
-        packing limit when the narrow sort-merge probe is the branch in use.
-        Violations flip ``ok`` rather than silently overcounting against
-        padding slots."""
+        packing limit when the narrow sort-merge probe is the branch in use
+        (the materializing probe never is: its searchsorted/union-scan
+        disciplines accept the full sub-sentinel range).  Violations flip
+        ``ok`` rather than silently overcounting against padding slots."""
         cfg = self.config
-        uses_merge = r.key_hi is None and cfg.sort_probe
+        uses_merge = (not materialize) and r.key_hi is None and cfg.sort_probe
         key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
         return (jnp.max(_sentinel_lane(r)) < key_cap) & (
             jnp.max(_sentinel_lane(s)) < key_cap)
+
+    @staticmethod
+    def _concat_hot(batch: TupleBatch, hot_batch) -> TupleBatch:
+        """Append the replicated hot build side (operators/skew.py) to a
+        local probe input; no-op without a skew plan."""
+        if hot_batch is None:
+            return batch
+        return TupleBatch(
+            key=jnp.concatenate([batch.key, hot_batch.key]),
+            rid=jnp.concatenate([batch.rid, hot_batch.rid]),
+            key_hi=None if batch.key_hi is None else jnp.concatenate(
+                [batch.key_hi, hot_batch.key_hi]))
+
+    @staticmethod
+    def _rollback_attempt(m, dts) -> None:
+        """Reclassify a superseded attempt's phase times into MWINWAIT (the
+        reference's stall column, Measurements.cpp:272-349) so the phase
+        columns report only the attempt that produced the result.  SNETCOMPL
+        is nested inside JMPI: rolled back from its own key but not
+        double-added to MWINWAIT."""
+        m.incr("RETRIES")
+        m.add_time_us("MWINWAIT",
+                      sum(v for k, v in dts.items() if k != "SNETCOMPL"))
+        for k, v in dts.items():
+            if v:
+                m.times_us[k] -= v
 
     def _single_node_sort_probe(self) -> bool:
         """True when the pipeline takes the n==1 specialization (no shuffle,
@@ -330,11 +358,15 @@ class HashJoin:
             out_specs=(spec, P()),
         ))
 
-    def _shuffle_fn(self, cap_r: int, cap_s: int, skew_plan=None):
+    def _shuffle_fn(self, cap_r: int, cap_s: int, skew_plan=None,
+                    materialize: bool = False):
         """Front half of the phase-split pipeline (config.measure_phases):
         phases 1-4 as their own program so the host timer sees JMPI — the
         reference's network-partitioning column (Measurements.cpp:140,
-        HashJoin.cpp:91-121) — separately from local processing."""
+        HashJoin.cpp:91-121) — separately from local processing.
+        ``materialize`` selects the materializing probe's key contract (pad
+        sentinels only — no 31-bit merge packing limit), matching the fused
+        _materialize_fn."""
         cfg = self.config
         ax = cfg.mesh_axes
         n = cfg.num_nodes
@@ -342,7 +374,7 @@ class HashJoin:
         win_s = Window(n, cap_s, ax, "outer")
 
         def body(r: TupleBatch, s: TupleBatch):
-            keys_ok = self._keys_in_contract(r, s)
+            keys_ok = self._keys_in_contract(r, s, materialize=materialize)
             rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
                 self._shuffle(r, s, win_r, win_s, skew_plan)
             sflags = jnp.stack([
@@ -352,7 +384,13 @@ class HashJoin:
                 conserve_bad.astype(jnp.uint32),
                 hot_overflow.astype(jnp.uint32),
             ])
-            out = (rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, sflags)
+            if materialize:
+                # the materializing probe consumes only the two batches (it
+                # re-derives nothing from valid/pid) — don't ship buffers
+                # across the program boundary that the consumer drops
+                out = (rp.batch, sp.batch, sflags)
+            else:
+                out = (rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, sflags)
             if skew_plan:
                 out = out + (hot_batch,)
             return out
@@ -362,7 +400,10 @@ class HashJoin:
         # replication check cannot prove it, so it travels "sharded": each
         # device keeps its identical copy as its shard and the probe program
         # slices the same copy back out — same bytes per device either way.
-        out_specs = (spec, spec, spec, spec, spec, P())
+        if materialize:
+            out_specs = (spec, spec, P())
+        else:
+            out_specs = (spec, spec, spec, spec, spec, P())
         if skew_plan:
             out_specs = out_specs + (spec,)
         return jax.jit(jax.shard_map(
@@ -395,25 +436,25 @@ class HashJoin:
             body, mesh=self.mesh, in_specs=in_specs,
             out_specs=(spec, P())))
 
-    def _run_split(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
-                   local_slack: int, skew_plan):
-        """Execute one attempt as separate phase programs, recording JMPI and
-        JPROC — plus SLOCPREP on the bucket path, where local partitioning
-        runs as its own program (the reference's LP/BP task columns,
-        Measurements.cpp:372-542) — from the host clock (the fused path can
-        only time their sum).  Returns (counts, flags ndarray, phase-dt dict
-        keyed by registry tag; SNETCOMPL is nested inside JMPI)."""
-        m = self.measurements
-        cfg = self.config
-        n = cfg.num_nodes
-        base = (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
+    def _split_key(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
+                   skew_plan):
+        n = self.config.num_nodes
+        return (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
                 r.key_hi is None, s.key_hi is None,
                 getattr(r.key, "sharding", None),
                 getattr(s.key, "sharding", None))
+
+    def _run_shuffle_program(self, r: TupleBatch, s: TupleBatch, cap_r: int,
+                             cap_s: int, skew_plan, base,
+                             materialize: bool = False):
+        """Compile + execute the standalone shuffle program, timing JMPI and
+        its nested completion wait.  Returns (shuffled outputs, shuffle-flag
+        ndarray, phase-dt dict)."""
+        m = self.measurements
         fn_mpi = self._compile_timed(
-            ("mpi",) + base,
-            lambda: self._shuffle_fn(cap_r, cap_s,
-                                     skew_plan).lower(r, s).compile())
+            ("mpim" if materialize else "mpi",) + base,
+            lambda: self._shuffle_fn(cap_r, cap_s, skew_plan,
+                                     materialize).lower(r, s).compile())
         dts = {}
         if m:
             m.start("JMPI")
@@ -427,7 +468,22 @@ class HashJoin:
             m.start("SNETCOMPL")
             dts["SNETCOMPL"] = m.stop("SNETCOMPL", fence=shuffled)
             dts["JMPI"] = m.stop("JMPI", fence=shuffled)
-        sflags = np.asarray(shuffled[5])
+        sflags = np.asarray(shuffled[2 if materialize else 5])
+        return shuffled, sflags, dts
+
+    def _run_split(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
+                   local_slack: int, skew_plan):
+        """Execute one attempt as separate phase programs, recording JMPI and
+        JPROC — plus SLOCPREP on the bucket path, where local partitioning
+        runs as its own program (the reference's LP/BP task columns,
+        Measurements.cpp:372-542) — from the host clock (the fused path can
+        only time their sum).  Returns (counts, flags ndarray, phase-dt dict
+        keyed by registry tag; SNETCOMPL is nested inside JMPI)."""
+        m = self.measurements
+        cfg = self.config
+        base = self._split_key(r, s, cap_r, cap_s, skew_plan)
+        shuffled, sflags, dts = self._run_shuffle_program(
+            r, s, cap_r, cap_s, skew_plan, base)
         if cfg.bucket_path:
             # three-program chain: the second radix pass is its own program
             # timed as SLOCPREP (skew/chunk can't combine with the bucket
@@ -468,6 +524,61 @@ class HashJoin:
                           int(np.asarray(local_flag)), sflags[4]],
                          dtype=np.uint32)
         return counts, flags, dts
+
+    def _materialize_probe_fn(self, rate_cap: int, skew_plan=None):
+        """Back half of the materializing phase split: the rid-pair-emitting
+        probe on the shuffled buffers (JPROC)."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+
+        def run(rp_batch, sp_batch, hot_batch):
+            rb = self._concat_hot(rp_batch, hot_batch)
+            if cfg.chunk_size:
+                mm = probe_materialize_chunked(
+                    _as_compressed(rb), _as_compressed(sp_batch),
+                    rate_cap, cfg.chunk_size)
+            else:
+                mm = probe_materialize(_as_compressed(rb),
+                                       _as_compressed(sp_batch), rate_cap)
+            return (mm.r_rid, mm.s_rid, mm.valid,
+                    jax.lax.psum(mm.overflow.astype(jnp.uint32), ax))
+
+        spec = P(ax)
+        if skew_plan:
+            def body(rpb, spb, hot):
+                return run(rpb, spb, hot)
+            in_specs = (spec, spec, spec)
+        else:
+            def body(rpb, spb):
+                return run(rpb, spb, None)
+            in_specs = (spec, spec)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(spec, spec, spec, P())))
+
+    def _run_split_materialize(self, r: TupleBatch, s: TupleBatch,
+                               cap_r: int, cap_s: int, rate_cap: int,
+                               skew_plan):
+        """Materializing attempt as two programs (shuffle -> probe), the
+        measure_phases discipline for join_materialize.  Returns
+        (r_rid, s_rid, valid, flags ndarray, phase-dt dict)."""
+        m = self.measurements
+        base = self._split_key(r, s, cap_r, cap_s, skew_plan)
+        shuffled, sflags, dts = self._run_shuffle_program(
+            r, s, cap_r, cap_s, skew_plan, base, materialize=True)
+        probe_args = tuple(shuffled[:2]) + tuple(shuffled[3:])
+        fn_mp = self._compile_timed(
+            ("mprobe", rate_cap) + base,
+            lambda: self._materialize_probe_fn(rate_cap, skew_plan
+                                               ).lower(*probe_args).compile())
+        if m:
+            m.start("JPROC")
+        r_rid, s_rid, valid, ovf = fn_mp(*probe_args)
+        if m:
+            dts["JPROC"] = m.stop("JPROC", fence=valid)
+        flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
+                          int(np.asarray(ovf)), sflags[4]], dtype=np.uint32)
+        return r_rid, s_rid, valid, flags, dts
 
     def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int):
         """Per-bucket capacities of the second radix pass."""
@@ -716,13 +827,7 @@ class HashJoin:
                 jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
             rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
                 self._shuffle(r, s, win_r, win_s, skew_plan)
-            rb = rp.batch
-            if hot_batch is not None:
-                rb = TupleBatch(
-                    key=jnp.concatenate([rb.key, hot_batch.key]),
-                    rid=jnp.concatenate([rb.rid, hot_batch.rid]),
-                    key_hi=None if rb.key_hi is None else jnp.concatenate(
-                        [rb.key_hi, hot_batch.key_hi]))
+            rb = self._concat_hot(rp.batch, hot_batch)
             if cfg.chunk_size:
                 # out-of-core discipline for the materializing probe too
                 # (LD output kernels, kernels.cu:778-856)
@@ -873,21 +978,9 @@ class HashJoin:
             if diag["hot_overflow"]:
                 skew_plan = (skew_plan[0], 2 * skew_plan[1])
             if m and attempt < self.config.max_retries:
-                # A superseded attempt's device time is window-wait, not join
-                # work: reclassify it as MWINWAIT (the reference's stall
-                # column, Measurements.cpp:272-349) so the phase columns
-                # report only the attempt that produced the result.  When
-                # retries are exhausted the last attempt IS the result —
-                # keep its time.  SNETCOMPL is nested inside JMPI, so it is
-                # rolled back from its own key but not double-added to
-                # MWINWAIT.
-                m.incr("RETRIES")
-                m.add_time_us("MWINWAIT",
-                              sum(v for k, v in dts.items()
-                                  if k != "SNETCOMPL"))
-                for k, v in dts.items():
-                    if v:
-                        m.times_us[k] -= v
+                # when retries are exhausted the last attempt IS the result
+                # — keep its time (see _rollback_attempt)
+                self._rollback_attempt(m, dts)
         counts = self._to_host(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
@@ -921,20 +1014,28 @@ class HashJoin:
         if m:
             m.stop("SWINALLOC")
         rate_cap = self.config.match_rate_cap
+        use_split = self.config.measure_phases
         for attempt in range(self.config.max_retries + 1):
-            key = ("mat", r.size // n, s.size // n, cap_r, cap_s, rate_cap,
-                   skew_plan, r.key_hi is None, s.key_hi is None,
-                   getattr(r.key, "sharding", None),
-                   getattr(s.key, "sharding", None))
-            fn = self._compile_timed(
-                key,
-                lambda: self._materialize_fn(cap_r, cap_s, rate_cap,
-                                             skew_plan).lower(r, s).compile())
-            if m:
-                m.start("JPROC")
-            r_rid, s_rid, valid, flags = fn(r, s)
-            dt_proc = (m.stop("JPROC", fence=(r_rid, flags)) if m else 0.0)
-            flags = np.asarray(flags)
+            if use_split:
+                r_rid, s_rid, valid, flags, dts = self._run_split_materialize(
+                    r, s, cap_r, cap_s, rate_cap, skew_plan)
+            else:
+                key = ("mat", r.size // n, s.size // n, cap_r, cap_s,
+                       rate_cap, skew_plan, r.key_hi is None,
+                       s.key_hi is None,
+                       getattr(r.key, "sharding", None),
+                       getattr(s.key, "sharding", None))
+                fn = self._compile_timed(
+                    key,
+                    lambda: self._materialize_fn(
+                        cap_r, cap_s, rate_cap, skew_plan
+                    ).lower(r, s).compile())
+                if m:
+                    m.start("JPROC")
+                r_rid, s_rid, valid, flags = fn(r, s)
+                dts = ({"JPROC": m.stop("JPROC", fence=(r_rid, flags))}
+                       if m else {})
+                flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
@@ -947,9 +1048,7 @@ class HashJoin:
             if diag["hot_overflow"]:
                 skew_plan = (skew_plan[0], 2 * skew_plan[1])
             if m and attempt < self.config.max_retries:
-                m.incr("RETRIES")
-                m.add_time_us("MWINWAIT", dt_proc)
-                m.times_us["JPROC"] -= dt_proc
+                self._rollback_attempt(m, dts)
         if getattr(valid, "is_fully_addressable", True):
             valid = np.asarray(valid)
             r_rid = np.asarray(r_rid)[valid]
