@@ -190,9 +190,13 @@ def _rel64(keys64):
     following the wide shard_np contract — (key_lo, key_hi, rid) 3-tuples
     (relation.Relation.shard_np)."""
     class _Fixed:
+        key_bits = 64
+        kind = "fixed"
         def __init__(self, k):
             self.k = k
             self.num_nodes = 4
+        def generate_sharded(self, mesh, axes):
+            return None   # host-only test double
         def shard_np(self, i):
             n = len(self.k) // 4
             sl = self.k[i * n:(i + 1) * n]

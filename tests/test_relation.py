@@ -46,3 +46,63 @@ def test_zipf_within_domain():
     assert keys.max() < 500
     r = Relation(global_size=500, kind="unique")
     assert r.expected_matches(s) == 1000
+
+
+def test_generate_sharded_matches_host():
+    """On-device sharded generation (generate_sharded) is bit-identical to
+    the host shard_np path per shard, for every supported kind x width, on
+    the 8-device virtual mesh (SURVEY.md §7.4 item 5)."""
+    from tpu_radix_join.parallel.mesh import make_mesh
+
+    mesh = make_mesh(8)
+    cases = [
+        Relation(1 << 13, 8, "unique", seed=31),
+        Relation(1 << 13, 8, "unique", seed=32, key_bits=64),
+        Relation(1 << 13, 8, "modulo", seed=33, modulo=777),
+        Relation(3000 * 8, 8, "unique", seed=34),   # non-pow2 domain
+    ]
+    for rel in cases:
+        batch = rel.generate_sharded(mesh, "nodes")
+        assert batch is not None
+        keys = np.asarray(batch.key).reshape(8, -1)
+        rids = np.asarray(batch.rid).reshape(8, -1)
+        his = (np.asarray(batch.key_hi).reshape(8, -1)
+               if batch.key_hi is not None else None)
+        for node in range(8):
+            sh = rel.shard_np(node)
+            np.testing.assert_array_equal(keys[node], sh[0])
+            np.testing.assert_array_equal(rids[node], sh[-1])
+            if his is not None:
+                np.testing.assert_array_equal(his[node], sh[1])
+    # zipf has no device twin (f64 CDF): generate_sharded declines
+    z = Relation(1 << 12, 8, "zipf", zipf_theta=0.75)
+    assert z.generate_sharded(mesh, "nodes") is None
+
+
+def test_generation_modes_drive_join():
+    """place() honors config.generation: auto/device produce the same batch
+    as host (bit-identical generators), and 'device' refuses kinds without
+    an on-device generator."""
+    import pytest
+
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.operators.hash_join import HashJoin
+
+    rel = Relation(1 << 12, 4, "unique", seed=41)
+    zipf = Relation(1 << 12, 4, "zipf", zipf_theta=0.9, seed=42)
+    by_mode = {}
+    for mode in ("auto", "host", "device"):
+        eng = HashJoin(JoinConfig(num_nodes=4, generation=mode))
+        by_mode[mode] = eng.place(rel)
+        res = eng.join(rel, Relation(1 << 12, 4, "unique", seed=43))
+        assert res.ok and res.matches == 1 << 12
+    np.testing.assert_array_equal(np.asarray(by_mode["auto"].key),
+                                  np.asarray(by_mode["host"].key))
+    np.testing.assert_array_equal(np.asarray(by_mode["device"].key),
+                                  np.asarray(by_mode["host"].key))
+    # auto falls back to host for zipf; device refuses
+    eng_auto = HashJoin(JoinConfig(num_nodes=4, generation="auto"))
+    assert eng_auto.place(zipf).key.shape == ((1 << 12),)
+    eng_dev = HashJoin(JoinConfig(num_nodes=4, generation="device"))
+    with pytest.raises(ValueError, match="device"):
+        eng_dev.place(zipf)

@@ -84,6 +84,16 @@ class JoinConfig:
     # uint32 bit mask).
     skew_threshold: Optional[float] = None
 
+    # --- data placement --------------------------------------------------------
+    # How Relation-driven entry points materialize shards (SURVEY.md §7.4
+    # item 5): "auto" generates on device when the relation kind supports it
+    # (unique/modulo — no host materialization, no host->device transfer) and
+    # falls back to host generation + device_put otherwise (zipf's f64 CDF);
+    # "host" forces the host path (the bit-identical twin, useful for
+    # debugging); "device" requires on-device generation and raises for
+    # unsupported kinds.
+    generation: str = "auto"
+
     # --- instrumentation -------------------------------------------------------
     debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
     # Phase-split timing (Measurements.cpp:139-141 JMPI/JPROC columns): run
@@ -111,6 +121,8 @@ class JoinConfig:
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.generation not in ("auto", "host", "device"):
+            raise ValueError(f"unknown generation mode {self.generation!r}")
         if self.skew_threshold is not None:
             if self.skew_threshold <= 0:
                 raise ValueError("skew_threshold must be positive")

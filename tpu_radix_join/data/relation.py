@@ -145,9 +145,11 @@ def _feistel_jax(idx: jnp.ndarray, round_keys: jnp.ndarray, domain_bits: int) ->
     return (l.astype(jnp.uint32) << half) | r
 
 
-def unique_keys_device(start: int, count: int, global_size: int, seed: int) -> jnp.ndarray:
+def unique_keys_device(start, count: int, global_size: int, seed: int) -> jnp.ndarray:
     """Shard [start, start+count) of a seeded permutation of [0, global_size),
-    computed entirely on device via Feistel + cycle-walking.
+    computed entirely on device via Feistel + cycle-walking.  ``start`` may be
+    a Python int or a traced uint32 scalar (generate_sharded passes the
+    per-device ``axis_index``-derived offset).
 
     Requires domain 2**b >= global_size; indices mapping outside
     [0, global_size) are re-walked until they land inside (expected <= 2 steps
@@ -319,6 +321,55 @@ class Relation:
             key = jnp.asarray(key_np)
         hi = key_hi_lane(key) if self.key_bits == 64 else None
         return TupleBatch(key=key, rid=rid, key_hi=hi)
+
+    def generate_sharded(self, mesh, axes) -> Optional[TupleBatch]:
+        """The whole relation generated **on device**, sharded over ``mesh``
+        along ``axes`` (device i holds node i's slice) — no host
+        materialization and no host->device transfer (SURVEY.md §7.4 item 5:
+        "generate sharded on-device rather than host-side like
+        Relation::fillUniqueValues").
+
+        Bit-identical to the ``shard_np`` host path for the supported kinds
+        ("unique": same Feistel rounds + cycle walk; "modulo": same dense-rid
+        residues).  Returns ``None`` for "zipf", whose float64 CDF inversion
+        has no TPU twin (no f64 on device) — callers fall back to host
+        generation.
+        """
+        if self.kind not in ("unique", "modulo"):
+            return None
+        n = int(np.prod(mesh.devices.shape))
+        if n != self.num_nodes:
+            raise ValueError(
+                f"mesh has {n} devices, relation expects {self.num_nodes}")
+        local = self.local_size
+        wide = self.key_bits == 64
+        kind = self.kind
+        gs = self.global_size
+        seed = self.seed
+        modulo = self.modulo
+        from jax.sharding import PartitionSpec
+
+        def gen():
+            i = jax.lax.axis_index(axes)   # flat rank over the (maybe
+            lo = i.astype(jnp.uint32) * jnp.uint32(local)   # hierarchical) mesh
+            rid = jnp.arange(local, dtype=jnp.uint32) + lo
+            if kind == "unique":
+                key = unique_keys_device(lo, local, gs, seed)
+            else:
+                key = rid % jnp.uint32(modulo)
+            if wide:
+                return key, key_hi_lane(key), rid
+            return key, rid
+
+        spec = PartitionSpec(axes)
+        out_specs = (spec, spec, spec) if wide else (spec, spec)
+        out = jax.jit(jax.shard_map(
+            gen, mesh=mesh, in_specs=(), out_specs=out_specs))()
+        if wide:
+            key, hi, rid = out
+            return TupleBatch(key=key, rid=rid, key_hi=hi)
+        key, rid = out
+        return TupleBatch(key=key, rid=rid, key_hi=None)
 
     # ---------------------------------------------------------------- oracle
     def expected_matches(self, outer: "Relation") -> Optional[int]:

@@ -54,6 +54,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run shuffle and probe as separate programs so "
                         ".perf carries JMPI and JPROC columns (costs the "
                         "cross-phase fusion)")
+    p.add_argument("--generation", choices=["auto", "host", "device"],
+                   default="auto",
+                   help="relation materialization: on-device sharded "
+                        "generation when supported (auto/device) or host "
+                        "numpy + transfer (host)")
     p.add_argument("--outer-kind", choices=["unique", "modulo", "zipf"],
                    default="unique")
     p.add_argument("--modulo", type=int, default=None)
@@ -94,6 +99,7 @@ def main(argv=None) -> int:
         chunk_size=args.chunk_size,
         max_retries=args.max_retries,
         skew_threshold=args.skew_threshold,
+        generation=args.generation,
         debug_checks=args.debug_checks,
         measure_phases=args.measure_phases,
     )
@@ -114,9 +120,15 @@ def main(argv=None) -> int:
     engine = HashJoin(cfg, measurements=meas)
 
     expected = inner.expected_matches(outer)
+    # Generate + place once, join --repeat times: the reference generates
+    # before its join timers start (main.cpp:94-116), so repeats must not
+    # re-pay generation/transfer — with host generation the device_put
+    # completes lazily inside the first join's fence, silently inflating
+    # JPROC by the transfer time on remote-attached devices.
+    r_batch, s_batch = engine.place(inner), engine.place(outer)
     result = None
     for i in range(args.repeat):
-        result = engine.join(inner, outer)
+        result = engine.join_arrays(r_batch, s_batch)
     if args.repeat > 1:
         # RESULTS accumulates per join; the report's "Tuples" line means THE
         # join's result count.  Times/tuple counters stay cumulative (JRATE

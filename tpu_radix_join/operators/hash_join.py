@@ -1073,25 +1073,47 @@ class HashJoin:
                                       matches=int(valid.sum()),
                                       ok=not flags.any(), diagnostics=diag)
 
-    def _place(self, rel: Relation) -> TupleBatch:
+    def place(self, rel: Relation) -> TupleBatch:
         """Generate a relation's shards and lay them out over the mesh.
 
-        ``shard_np`` yields ``(key, rid)`` or ``(key_lo, key_hi, rid)``
-        (relation.py contract); the lane count must agree with
+        ``config.generation`` picks the path: on-device sharded generation
+        (``Relation.generate_sharded`` — no host materialization or
+        host->device transfer; the reference generates host-side,
+        Relation.cpp:63-97, which SURVEY.md §7.4 item 5 calls out as the
+        thing NOT to scale) when the kind supports it, else host ``shard_np``
+        + ``device_put``.  Either way the lane count must agree with
         ``config.key_bits`` — a 64-bit config with 32-bit shards (or vice
         versa) raises rather than silently truncating (the failure class
         VERDICT r2 weak #1 flagged)."""
-        n = self.config.num_nodes
+        cfg = self.config
+        n = cfg.num_nodes
         if rel.num_nodes != n:
             raise ValueError("relation num_nodes must match config.num_nodes")
-        sharding = NamedSharding(self.mesh, P(self.config.mesh_axes))
-        shards = [rel.shard_np(i) for i in range(n)]
-        wide = len(shards[0]) == 3
-        if wide != (self.config.key_bits == 64):
+        if rel.key_bits != cfg.key_bits:
             raise ValueError(
-                f"config.key_bits={self.config.key_bits} but relation shards "
-                f"{'carry' if wide else 'lack'} a hi key lane — widen the "
-                f"config or regenerate with the matching key_bits")
+                f"config.key_bits={cfg.key_bits} but the relation generates "
+                f"{rel.key_bits}-bit keys ({'a spurious' if rel.key_bits == 64 else 'no'} "
+                f"hi key lane) — widen the config or regenerate with the "
+                f"matching key_bits")
+        if cfg.generation != "host":
+            batch = rel.generate_sharded(self.mesh, cfg.mesh_axes)
+            if batch is not None:
+                # fence before returning: generation is async, and the
+                # reference generates strictly before its join timers start
+                # (main.cpp:94-116) — an in-flight generation completing
+                # inside the first join's fence would inflate its phase times
+                return jax.block_until_ready(batch)
+            if cfg.generation == "device":
+                raise ValueError(
+                    f"generation='device' but relation kind {rel.kind!r} "
+                    f"has no on-device generator (host-only f64 tables)")
+        sharding = NamedSharding(self.mesh, P(cfg.mesh_axes))
+        shards = [rel.shard_np(i) for i in range(n)]
+        wide = rel.key_bits == 64   # authoritative; shard_np must agree
+        if len(shards[0]) != (3 if wide else 2):
+            raise ValueError(
+                f"shard_np returned {len(shards[0])} lanes but key_bits="
+                f"{rel.key_bits} implies {'(lo, hi, rid)' if wide else '(key, rid)'}")
 
         def put(arrs):
             full = np.concatenate(arrs)
@@ -1105,13 +1127,18 @@ class HashJoin:
         keys = put([sh[0] for sh in shards])
         rids = put([sh[-1] for sh in shards])
         hi = put([sh[1] for sh in shards]) if wide else None
-        return TupleBatch(key=keys, rid=rids, key_hi=hi)
+        # same fence as the device path: the transfer must not complete
+        # inside a later join's phase timers
+        return jax.block_until_ready(TupleBatch(key=keys, rid=rids, key_hi=hi))
+
+    # internal alias kept for call-site continuity (tests exercise it too)
+    _place = place
 
     def join(self, inner: Relation, outer: Relation) -> JoinResult:
         """Join two relation specs (generates shards, shards onto the mesh)."""
-        return self.join_arrays(self._place(inner), self._place(outer))
+        return self.join_arrays(self.place(inner), self.place(outer))
 
     def join_materialize(self, inner: Relation,
                          outer: Relation) -> MaterializedJoinResult:
-        return self.join_materialize_arrays(self._place(inner),
-                                            self._place(outer))
+        return self.join_materialize_arrays(self.place(inner),
+                                            self.place(outer))
