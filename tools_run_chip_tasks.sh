@@ -4,6 +4,7 @@
 # artifacts/chip_r3/.
 set -u
 cd /root/repo
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 OUT=artifacts/chip_r3
 mkdir -p "$OUT"
 
