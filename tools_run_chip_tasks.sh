@@ -37,4 +37,5 @@ run cli_20m_sort python -m tpu_radix_join.main --tuples-per-node 20000000 \
 run cli_20m_phases python -m tpu_radix_join.main --tuples-per-node 20000000 \
     --nodes 1 --two-level --measure-phases --repeat 3 \
     --output-dir "$OUT/perf_20m_phases"
+run out_of_core python experiments/exp_out_of_core.py 27 24
 echo "ALL_CHIP_TASKS_DONE $(date -u +%H:%M:%S)"

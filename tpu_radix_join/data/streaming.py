@@ -23,6 +23,7 @@ driver that wants relations larger than host or device memory.
 
 from __future__ import annotations
 
+import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
@@ -30,7 +31,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_radix_join.data.relation import Relation, key_hi_lane
+from tpu_radix_join.data.relation import (
+    Relation,
+    key_hi_lane,
+    unique_keys_device,
+)
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.memory.pool import Pool
 
@@ -90,3 +95,50 @@ def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
         ex.shutdown(wait=True)
         if own_pool:
             pool.close()
+
+
+@functools.partial(jax.jit, static_argnames=("n", "gs", "seed", "modulo",
+                                             "wide"))
+def _gen_chunk(start: jnp.ndarray, n: int, gs: int, seed: int,
+               modulo: Optional[int], wide: bool):
+    rid = jnp.arange(n, dtype=jnp.uint32) + start
+    if modulo is None:
+        key = unique_keys_device(start, n, gs, seed)
+    else:
+        key = rid % jnp.uint32(modulo)
+    return (key, key_hi_lane(key), rid) if wide else (key, rid)
+
+
+def stream_chunks_device(rel: Relation, node: int,
+                         chunk_tuples: int) -> Iterator[TupleBatch]:
+    """Yield one node's shard as **device-generated** TupleBatches — the
+    at-scale twin of :func:`stream_chunks` for kinds with on-device
+    generators (unique / modulo): each chunk's keys are computed on device
+    from its global index range (same Feistel walk / residues, bit-identical
+    to the host stream), so the host materializes and transfers nothing
+    (SURVEY.md §7.4 item 5).  Out-of-core grid joins stay compute-bound even
+    on transfer-starved attachments.  The zipf kind (host-only f64 CDF)
+    raises — use :func:`stream_chunks`.
+    """
+    if chunk_tuples < 1:
+        raise ValueError("chunk_tuples must be >= 1")
+    if rel.kind not in ("unique", "modulo"):
+        raise ValueError(
+            f"relation kind {rel.kind!r} has no on-device generator — "
+            f"use stream_chunks")
+    local = rel.local_size
+    base = node * local
+    num_chunks = -(-local // chunk_tuples)
+    wide = rel.key_bits == 64
+    modulo = rel.modulo if rel.kind == "modulo" else None
+    for i in range(num_chunks):
+        start = base + i * chunk_tuples
+        n = min(chunk_tuples, base + local - start)
+        out = _gen_chunk(jnp.uint32(start), n, rel.global_size, rel.seed,
+                         modulo, wide)
+        if wide:
+            key, hi, rid = out
+            yield TupleBatch(key=key, rid=rid, key_hi=hi)
+        else:
+            key, rid = out
+            yield TupleBatch(key=key, rid=rid, key_hi=None)
