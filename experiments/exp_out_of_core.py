@@ -8,7 +8,11 @@ both sides **device-generated** per chunk (data/streaming.stream_chunks_device)
 so the run measures the join engine, not the host attachment.  Exact oracle:
 unique ⋈ unique over the same range must count exactly GLOBAL matches.
 
-    python experiments/exp_out_of_core.py [global_log2=27] [chunk_log2=24]
+    python experiments/exp_out_of_core.py [global_log2=27] [chunk_log2=24] [key_bits=32]
+
+``global_log2 >= 31`` requires ``key_bits=64`` (the BASELINE config #5 shape:
+1B ⋈ 1B wide keys — ``python ... 30 26 64`` runs the full billion-scale grid
+on one chip, out of core).
 """
 
 import sys
@@ -26,16 +30,21 @@ from tpu_radix_join.ops.chunked import chunked_join_grid
 def main() -> int:
     glog = int(sys.argv[1]) if len(sys.argv) > 1 else 27
     clog = int(sys.argv[2]) if len(sys.argv) > 2 else 24
+    key_bits = int(sys.argv[3]) if len(sys.argv) > 3 else 32
     size, chunk = 1 << glog, 1 << clog
     print(f"device: {jax.devices()[0]}, global: {size:,} x {size:,}, "
-          f"chunk: {chunk:,} ({(size // chunk) ** 2} grid pairs)")
-    r = Relation(size, 1, "unique", seed=1)
-    s = Relation(size, 1, "unique", seed=2)
+          f"chunk: {chunk:,} ({(size // chunk) ** 2} grid pairs), "
+          f"key_bits: {key_bits}")
+    r = Relation(size, 1, "unique", seed=1, key_bits=key_bits)
+    s = Relation(size, 1, "unique", seed=2, key_bits=key_bits)
 
     t0 = time.perf_counter()
+    # both sides as generators: chunked_join_grid consumes the inner side
+    # exactly once and re-streams the outer per inner chunk, so device
+    # residency stays O(chunk) — required at the billion-scale config
     total = chunked_join_grid(
-        list(stream_chunks_device(r, 0, chunk)),   # inner chunks resident
-        lambda: stream_chunks_device(s, 0, chunk),  # outer re-streamed
+        stream_chunks_device(r, 0, chunk),
+        lambda: stream_chunks_device(s, 0, chunk),
         slab_size=chunk)
     dt = time.perf_counter() - t0
     ok = total == size

@@ -56,3 +56,19 @@ def test_cli_repeat_reports_single_join_tuples(capsys):
     out = capsys.readouterr().out
     assert "[RESULTS] Tuples: 2048" in out
     assert "Tuples: 6144" not in out
+
+
+def test_cli_generation_modes(capsys):
+    """--generation device and host produce the same exact result (the
+    bit-identical generator twins); device refuses kinds with no on-device
+    generator."""
+    for mode in ("device", "host"):
+        rc = main(["--tuples-per-node", "2048", "--nodes", "4",
+                   "--generation", mode])
+        out = capsys.readouterr().out
+        assert rc == 0, out
+        assert "[RESULTS] Tuples: 8192" in out
+    import pytest
+    with pytest.raises(ValueError, match="on-device"):
+        main(["--tuples-per-node", "2048", "--nodes", "4",
+              "--generation", "device", "--outer-kind", "zipf"])

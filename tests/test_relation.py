@@ -106,3 +106,19 @@ def test_generation_modes_drive_join():
     eng_dev = HashJoin(JoinConfig(num_nodes=4, generation="device"))
     with pytest.raises(ValueError, match="device"):
         eng_dev.place(zipf)
+
+
+def test_generate_sharded_hierarchical_mesh():
+    """Device generation over the 2-D (dcn, ici) mesh: the flat axis_index
+    ordering must match shard_np's node ordering exactly."""
+    from tpu_radix_join.parallel.mesh import make_hierarchical_mesh
+
+    mesh = make_hierarchical_mesh(2, 8)
+    rel = Relation(1 << 13, 8, "unique", seed=61)
+    batch = rel.generate_sharded(mesh, ("dcn", "ici"))
+    keys = np.asarray(batch.key).reshape(8, -1)
+    rids = np.asarray(batch.rid).reshape(8, -1)
+    for node in range(8):
+        k, r = rel.shard_np(node)
+        np.testing.assert_array_equal(keys[node], k)
+        np.testing.assert_array_equal(rids[node], r)

@@ -170,6 +170,29 @@ def unique_keys_device(start, count: int, global_size: int, seed: int) -> jnp.nd
     return v
 
 
+def _device_range(start, n: int, global_size: int, seed: int,
+                  modulo: Optional[int], wide: bool):
+    """Core on-device generator for the global index range
+    [start, start+n): ``(key[, key_hi], rid)`` uint32 lanes.  ``modulo=None``
+    selects the unique Feistel walk; a value selects dense-rid residues.
+    ``start`` may be a Python int or a traced uint32 scalar.  The single
+    source of truth for on-device generation — ``Relation.shard``,
+    ``Relation.generate_sharded`` and ``streaming.stream_chunks_device`` all
+    call it, so the bit-identity contract with the host generators lives in
+    one place."""
+    rid = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(start)
+    if modulo is None:
+        key = unique_keys_device(start, n, global_size, seed)
+    else:
+        key = rid % jnp.uint32(modulo)
+    return (key, key_hi_lane(key), rid) if wide else (key, rid)
+
+
+device_range = jax.jit(
+    _device_range,
+    static_argnames=("n", "global_size", "seed", "modulo", "wide"))
+
+
 class Relation:
     """A logical relation: a global keyspace spec + per-shard generators.
 
@@ -311,14 +334,21 @@ class Relation:
     # ---------------------------------------------------------------- device
     def shard(self, node: int) -> TupleBatch:
         """One node's shard as a device TupleBatch (generation on device for
-        the unique kind; host fallback otherwise)."""
+        the unique/modulo kinds; host fallback otherwise)."""
         lo = node * self.local_size
+        if self.kind in ("unique", "modulo"):
+            out = device_range(
+                lo, self.local_size, self.global_size, self.seed,
+                self.modulo if self.kind == "modulo" else None,
+                self.key_bits == 64)
+            if self.key_bits == 64:
+                key, hi, rid = out
+                return TupleBatch(key=key, rid=rid, key_hi=hi)
+            key, rid = out
+            return TupleBatch(key=key, rid=rid, key_hi=None)
+        key_np, _ = self.fill_np(lo, self.local_size)
+        key = jnp.asarray(key_np)
         rid = jnp.arange(lo, lo + self.local_size, dtype=jnp.uint32)
-        if self.kind == "unique":
-            key = unique_keys_device(lo, self.local_size, self.global_size, self.seed)
-        else:
-            key_np, _ = self.fill_np(lo, self.local_size)
-            key = jnp.asarray(key_np)
         hi = key_hi_lane(key) if self.key_bits == 64 else None
         return TupleBatch(key=key, rid=rid, key_hi=hi)
 
@@ -343,23 +373,15 @@ class Relation:
                 f"mesh has {n} devices, relation expects {self.num_nodes}")
         local = self.local_size
         wide = self.key_bits == 64
-        kind = self.kind
         gs = self.global_size
         seed = self.seed
-        modulo = self.modulo
+        modulo = self.modulo if self.kind == "modulo" else None
         from jax.sharding import PartitionSpec
 
         def gen():
             i = jax.lax.axis_index(axes)   # flat rank over the (maybe
             lo = i.astype(jnp.uint32) * jnp.uint32(local)   # hierarchical) mesh
-            rid = jnp.arange(local, dtype=jnp.uint32) + lo
-            if kind == "unique":
-                key = unique_keys_device(lo, local, gs, seed)
-            else:
-                key = rid % jnp.uint32(modulo)
-            if wide:
-                return key, key_hi_lane(key), rid
-            return key, rid
+            return _device_range(lo, local, gs, seed, modulo, wide)
 
         spec = PartitionSpec(axes)
         out_specs = (spec, spec, spec) if wide else (spec, spec)

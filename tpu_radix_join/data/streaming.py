@@ -23,7 +23,6 @@ driver that wants relations larger than host or device memory.
 
 from __future__ import annotations
 
-import functools
 from concurrent.futures import ThreadPoolExecutor
 from typing import Iterator, Optional
 
@@ -31,11 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_radix_join.data.relation import (
-    Relation,
-    key_hi_lane,
-    unique_keys_device,
-)
+from tpu_radix_join.data.relation import Relation, device_range, key_hi_lane
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.memory.pool import Pool
 
@@ -97,18 +92,6 @@ def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
             pool.close()
 
 
-@functools.partial(jax.jit, static_argnames=("n", "gs", "seed", "modulo",
-                                             "wide"))
-def _gen_chunk(start: jnp.ndarray, n: int, gs: int, seed: int,
-               modulo: Optional[int], wide: bool):
-    rid = jnp.arange(n, dtype=jnp.uint32) + start
-    if modulo is None:
-        key = unique_keys_device(start, n, gs, seed)
-    else:
-        key = rid % jnp.uint32(modulo)
-    return (key, key_hi_lane(key), rid) if wide else (key, rid)
-
-
 def stream_chunks_device(rel: Relation, node: int,
                          chunk_tuples: int) -> Iterator[TupleBatch]:
     """Yield one node's shard as **device-generated** TupleBatches — the
@@ -134,8 +117,7 @@ def stream_chunks_device(rel: Relation, node: int,
     for i in range(num_chunks):
         start = base + i * chunk_tuples
         n = min(chunk_tuples, base + local - start)
-        out = _gen_chunk(jnp.uint32(start), n, rel.global_size, rel.seed,
-                         modulo, wide)
+        out = device_range(start, n, rel.global_size, rel.seed, modulo, wide)
         if wide:
             key, hi, rid = out
             yield TupleBatch(key=key, rid=rid, key_hi=hi)
