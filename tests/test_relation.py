@@ -122,3 +122,22 @@ def test_generate_sharded_hierarchical_mesh():
         k, r = rel.shard_np(node)
         np.testing.assert_array_equal(keys[node], k)
         np.testing.assert_array_equal(rids[node], r)
+
+
+def test_device_generation_above_int31_offsets():
+    """Node offsets past 2**31 (legal: global_size caps at 2**32 - 1) must
+    not overflow JAX's weak-int32 scalar promotion in the device generators
+    (device_range / unique_keys_device)."""
+    from tpu_radix_join.data.streaming import stream_chunks_device
+
+    rel = Relation((1 << 32) - (1 << 20), 1 << 12, "unique", seed=1,
+                   key_bits=64)
+    node = (1 << 12) - 1          # start = node * local_size > 2**31
+    k, hi, rid = rel.shard_np(node)
+    m = 1 << 14
+    batch = next(stream_chunks_device(rel, node, m))
+    np.testing.assert_array_equal(np.asarray(batch.key), k[:m])
+    np.testing.assert_array_equal(np.asarray(batch.key_hi), hi[:m])
+    np.testing.assert_array_equal(np.asarray(batch.rid), rid[:m])
+    sh = rel.shard(node)
+    np.testing.assert_array_equal(np.asarray(sh.key)[:m], k[:m])

@@ -157,13 +157,16 @@ def unique_keys_device(start, count: int, global_size: int, seed: int) -> jnp.nd
     domain_bits = max(2, (global_size - 1).bit_length())
     rk = jnp.asarray(_feistel_keys(seed))
     idx = (jnp.arange(count, dtype=jnp.uint32) + jnp.uint32(start))
+    # bind as uint32: a bare Python int >= 2**31 (global_size caps at
+    # 2**32 - 1) would overflow JAX's weak-int32 scalar promotion
+    gs = jnp.uint32(global_size)
 
     def body(v):
         out = _feistel_jax(v, rk, domain_bits)
-        return jnp.where(v < global_size, v, out)  # only walk still-outside values
+        return jnp.where(v < gs, v, out)  # only walk still-outside values
 
     def cond(v):
-        return jnp.any(v >= global_size)
+        return jnp.any(v >= gs)
 
     v = _feistel_jax(idx, rk, domain_bits)
     v = jax.lax.while_loop(cond, body, v)
@@ -188,9 +191,19 @@ def _device_range(start, n: int, global_size: int, seed: int,
     return (key, key_hi_lane(key), rid) if wide else (key, rid)
 
 
-device_range = jax.jit(
+_device_range_jit = jax.jit(
     _device_range,
     static_argnames=("n", "global_size", "seed", "modulo", "wide"))
+
+
+def device_range(start, n: int, global_size: int, seed: int,
+                 modulo: Optional[int], wide: bool):
+    """Jitted :func:`_device_range`.  ``start`` is coerced to uint32 before
+    the jit boundary: a bare Python int above 2**31 - 1 (reachable — node
+    offsets run up to ``global_size``, capped at 2**32 - 1) would otherwise
+    overflow JAX's default int32 argument parsing."""
+    return _device_range_jit(np.uint32(start), n, global_size, seed,
+                             modulo, wide)
 
 
 class Relation:
