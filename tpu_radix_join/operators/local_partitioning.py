@@ -24,10 +24,15 @@ from typing import NamedTuple
 import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import TupleBatch
-from tpu_radix_join.ops.radix import scatter_to_blocks, local_histogram, exclusive_cumsum
+from tpu_radix_join.ops.radix import scatter_to_blocks, exclusive_cumsum
 
 
 class LocalPartitionResult(NamedTuple):
+    """``histogram``/``offsets`` are the reference's intermediate artifacts
+    (computeHistogram / computePrefixSum, LocalPartitioning.cpp:138-192),
+    exposed for parity and diagnostics; the shipping pipeline consumes only
+    ``blocks``/``overflow``, and XLA dead-code-eliminates the rest at zero
+    runtime cost."""
     blocks: TupleBatch       # [num_buckets * capacity] lanes, sentinel-padded
     histogram: jnp.ndarray   # uint32 [num_buckets] — true per-bucket demand
     offsets: jnp.ndarray     # uint32 [num_buckets] — exclusive prefix sum
@@ -54,7 +59,11 @@ def local_partition(
     lpid = local_bucket_ids(batch, network_fanout_bits, local_fanout_bits)
     blocks, counts, overflow = scatter_to_blocks(
         batch, lpid, num_buckets, capacity, side, valid=valid)
-    hist = local_histogram(lpid, num_buckets, valid)
+    # counts IS the per-bucket histogram: scatter_to_blocks derives it from
+    # run boundaries of the same (valid-masked) bucket ids, so a separate
+    # histogram pass over the tuples would recompute it byte-for-byte
+    # (LocalPartitioning.cpp computes its histogram separately only because
+    # its reorder needs the prefix sums *before* writing).
     return LocalPartitionResult(
-        blocks=blocks, histogram=hist, offsets=exclusive_cumsum(hist),
+        blocks=blocks, histogram=counts, offsets=exclusive_cumsum(counts),
         overflow=overflow)
