@@ -161,6 +161,9 @@ def main(argv=None) -> int:
             for line in meas.lines():
                 print(f"[PERF] {line}")
     if args.output_dir:
+        # the post-join memory checkpoint (JOIN_MEM_DEBUG analog,
+        # main.cpp:32,68,92): lands in <rank>.info under "memory"
+        meas.memory_utilization()
         path = meas.store(args.output_dir)
         if jax.process_index() == 0:
             print(f"[PERF] stored {path}")
