@@ -32,7 +32,8 @@ def _random_case(case: int):
     if not two_level and rng.random() < 0.3:
         chunk = int(rng.choice([256, 1024]))
     skew = None
-    if (not two_level and chunk is None and window == "measured"
+    # skew composes with two_level since r4; only chunking excludes it
+    if (chunk is None and window == "measured"
             and fanout <= 5 and rng.random() < 0.3):
         skew = float(rng.uniform(1.5, 4.0))
     key_bits = 64 if rng.random() < 0.3 else 32

@@ -187,13 +187,35 @@ def test_zipf_skew_split_end_to_end():
 
 def test_config_rejects_unsupported_skew_combos():
     with pytest.raises(ValueError):
-        JoinConfig(skew_threshold=2.0, two_level=True)
-    with pytest.raises(ValueError):
-        JoinConfig(skew_threshold=2.0, probe_algorithm="bucket")
+        JoinConfig(skew_threshold=2.0, chunk_size=256)
     with pytest.raises(ValueError):
         JoinConfig(skew_threshold=2.0, network_fanout_bits=6)
     with pytest.raises(ValueError):
         JoinConfig(skew_threshold=2.0, window_sizing="static")
+
+
+@pytest.mark.parametrize("phases", [False, True])
+def test_skew_split_on_two_level_path(phases):
+    """The split composes with the two-level/bucket discipline (VERDICT r3
+    missing #4 — the reference's own skew locus is its PARTITIONED probe
+    kernels, kernels_optimized.cu:301-943): the replicated hot build side
+    rides the local radix pass, hot S spreads by rid, and the per-bucket
+    probe counts exactly — fused and phase-split (SLOCPREP/JPROC) alike,
+    agreeing with the flat sort-probe pipeline."""
+    n, size = 8, 1 << 14
+    r, s = _hot_workload(size)
+    cfg = JoinConfig(num_nodes=n, two_level=True, local_fanout_bits=3,
+                     skew_threshold=4.0, allocation_factor=4.0,
+                     max_retries=3, measure_phases=phases)
+    hj = HashJoin(cfg)
+    _, _, plan = hj._measure_capacities(r, s)
+    assert plan is not None and plan[0] != 0   # detection actually fired
+    res = hj.join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    assert res.matches == size
+    flat = HashJoin(JoinConfig(num_nodes=n, skew_threshold=4.0,
+                               max_retries=3)).join_arrays(r, s)
+    assert flat.ok and flat.matches == res.matches
 
 
 def test_materialize_with_skew_split():

@@ -79,9 +79,13 @@ class JoinConfig:
     # partitions whose global OUTER weight exceeds skew_threshold x the mean
     # total weight (and whose inner side is cheap enough to replicate) are
     # split — inner side replicated via all_gather, outer side spread by a
-    # rid hash — instead of owned by one node.  None disables.  Requires
-    # the sort probe discipline and network fanout <= 5 (the hot set is a
-    # uint32 bit mask).
+    # rid hash — instead of owned by one node.  None disables.  Composes
+    # with the sort probe AND the two-level/bucket discipline (the
+    # reference's own skew locus is its partitioned probe kernels,
+    # kernels_optimized.cu:301-943: replicated hot R simply joins the local
+    # radix pass); only the chunked out-of-core probe is excluded (see
+    # __post_init__).  Requires network fanout <= 5 (the hot set is a
+    # uint32 bit mask) and measured window sizing.
     skew_threshold: Optional[float] = None
 
     # --- data placement --------------------------------------------------------
@@ -126,10 +130,15 @@ class JoinConfig:
         if self.skew_threshold is not None:
             if self.skew_threshold <= 0:
                 raise ValueError("skew_threshold must be positive")
-            if self.two_level or self.probe_algorithm == "bucket" or self.chunk_size:
+            if self.chunk_size:
                 raise ValueError(
-                    "skew splitting requires the sort probe discipline "
-                    "(two_level/bucket/chunked probes have no split path)")
+                    "skew splitting does not compose with the chunked "
+                    "out-of-core probe: the split replicates the hot inner "
+                    "side onto every device (operators/skew.py), growing "
+                    "exactly the resident working set chunking exists to "
+                    "bound — for skewed out-of-core joins run the grid join "
+                    "(ops/chunked.chunked_join_grid), whose per-pair probes "
+                    "need no hot-side replication")
             if self.network_fanout_bits > 5:
                 raise ValueError(
                     "skew splitting supports network fanout <= 5 "

@@ -191,6 +191,12 @@ def _device_range(start, n: int, global_size: int, seed: int,
     return (key, key_hi_lane(key), rid) if wide else (key, rid)
 
 
+# NOTE: every distinct (n, global_size, seed, modulo, wide) tuple — i.e.
+# every relation spec and every ragged tail-chunk size — compiles its own
+# XLA program (the Feistel round-key table is baked in at trace time, which
+# is what makes the device twin bit-identical to the host path).  Expected
+# and acceptable: sweeps over many tiny relation specs pay a per-spec
+# compile; production-shape runs reuse one or two entries (ADVICE r3).
 _device_range_jit = jax.jit(
     _device_range,
     static_argnames=("n", "global_size", "seed", "modulo", "wide"))

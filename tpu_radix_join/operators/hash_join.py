@@ -9,9 +9,14 @@ by XLA over the mesh; MPI barriers (HashJoin.cpp:50,120) become XLA program
 order, and the sequential ``TASK_QUEUE`` drain (HashJoin.cpp:187-204) becomes
 vectorized per-partition work in the same program.
 
-Match counts are returned per network partition in uint32 (each partition's
-count stays < 2**32) and summed on host in uint64 so billion-scale totals are
-exact without device int64 (SURVEY.md §7.4 item 2).
+Match counts are returned per network partition in uint32 and summed on host
+in uint64 so billion-scale totals are exact without device int64 (SURVEY.md
+§7.4 item 2).  The "each partition's count stays < 2**32" contract is
+guarded at runtime (:meth:`HashJoin._count_risk`): the probe's max match
+weight bounds every partition's count, and a workload that could wrap flips
+``count_overflow_risk`` (ok=False) — the reference cannot wrap by
+construction (uint64 RESULT_COUNTER, operators/HashJoin.h:26), so neither,
+observably, can this pipeline.
 """
 
 from __future__ import annotations
@@ -192,6 +197,19 @@ class HashJoin:
             key_hi=None if batch.key_hi is None else jnp.concatenate(
                 [batch.key_hi, hot_batch.key_hi]))
 
+    @classmethod
+    def _concat_hot_valid(cls, batch: TupleBatch, valid, hot_batch):
+        """(batch + hot, valid + hot-valid) for paths that carry an explicit
+        valid lane (the bucket discipline's local radix pass): the hot
+        block's padding slots are R sentinels, so validity IS the sentinel
+        test — one definition shared by the fused and phase-split pipelines
+        so they cannot diverge."""
+        if hot_batch is None:
+            return batch, valid
+        hot_valid = _sentinel_lane(hot_batch) < jnp.uint32(R_PAD_KEY)
+        return (cls._concat_hot(batch, hot_batch),
+                jnp.concatenate([valid, hot_valid]))
+
     @staticmethod
     def _rollback_attempt(m, dts) -> None:
         """Reclassify a superseded attempt's phase times into MWINWAIT (the
@@ -259,14 +277,19 @@ class HashJoin:
     def _compile_timed(self, key, build):
         """Compile-and-cache with JCOMPILE attribution — the single place
         compile time enters the registry (the reference has no runtime
-        compilation; this tag keeps it out of every phase column)."""
+        compilation; this tag keeps it out of every phase column).  Running
+        outer timers (JTOTAL, SWINALLOC) are shifted past the compile so the
+        reported phases stay reference-comparable: the reference's JTOTAL has
+        no compile in it, and a compile-dominated JTOTAL understated the
+        engine's CLI throughput ~50x at 20M (VERDICT r3 weak #5)."""
         if key not in self._compiled:
             m = self.measurements
             if m:
                 m.start("JCOMPILE")
             self._compiled[key] = build()
             if m:
-                m.stop("JCOMPILE")
+                dt = m.stop("JCOMPILE")
+                m.exclude_from_running(dt)
         return self._compiled[key]
 
     def _run_hist(self, r: TupleBatch, s: TupleBatch, hot_bits: int):
@@ -313,27 +336,36 @@ class HashJoin:
                 # requires partitioned buffers — the merge probe does not),
                 # so phases 2-5 vanish and JPROC is the probe alone.
                 if r.key_hi is not None:
-                    counts = merge_count_wide_per_partition(
-                        r.key, r.key_hi, s.key, s.key_hi, fanout)
+                    counts, maxw = merge_count_wide_per_partition(
+                        r.key, r.key_hi, s.key, s.key_hi, fanout,
+                        return_max_weight=True)
                 else:
-                    counts = merge_count_per_partition(r.key, s.key, fanout)
+                    counts, maxw = merge_count_per_partition(
+                        r.key, s.key, fanout, return_max_weight=True)
+                # overflow-risk bound: no shuffle histograms exist on this
+                # path, so one histogram pass over the outer pids buys the
+                # per-partition outer counts the bound needs
+                s_pid = s.key & jnp.uint32(num_p - 1)
+                count_risk = self._count_risk(
+                    maxw, local_histogram(s_pid, num_p))
                 zero = jnp.uint32(0)
                 flags = jnp.stack([
                     jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
                     zero, zero, zero, zero, zero,
+                    jax.lax.psum(count_risk.astype(jnp.uint32), ax),
                 ])
                 return counts, flags
 
             # ---- Phases 1-4: histograms, window allocation (implicit in
             # static shapes), all_to_all shuffle, conservation barrier
             # (HashJoin.cpp:58-121) — shared with the materialize variant ----
-            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
-                self._shuffle(r, s, win_r, win_s, skew_plan)
+            (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
+             s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
-            counts, local_overflow = self._local_process(
+            counts, local_overflow, count_risk = self._local_process(
                 rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, hot_batch,
-                cap_r, cap_s, local_slack)
+                cap_r, cap_s, local_slack, s_hist_bound=s_gh)
 
             # Failure breakdown, globally reduced (SURVEY.md section 5.3: the
             # reference aborts on any failure; here every mode is counted so
@@ -348,6 +380,7 @@ class HashJoin:
                 conserve_bad.astype(jnp.uint32),
                 jax.lax.psum(local_overflow.astype(jnp.uint32), ax),
                 hot_overflow.astype(jnp.uint32),
+                jax.lax.psum(count_risk.astype(jnp.uint32), ax),
             ])
             return counts, flags
 
@@ -375,8 +408,8 @@ class HashJoin:
 
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = self._keys_in_contract(r, s, materialize=materialize)
-            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
-                self._shuffle(r, s, win_r, win_s, skew_plan)
+            (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
+             _s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
             sflags = jnp.stack([
                 jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
                 lost_r.astype(jnp.uint32),
@@ -418,10 +451,12 @@ class HashJoin:
         ax = cfg.mesh_axes
 
         def run(rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch):
-            counts, local_overflow = self._local_process(
+            counts, local_overflow, count_risk = self._local_process(
                 rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
                 cap_r, cap_s, local_slack)
-            return counts, jax.lax.psum(local_overflow.astype(jnp.uint32), ax)
+            return (counts,
+                    jax.lax.psum(local_overflow.astype(jnp.uint32), ax),
+                    jax.lax.psum(count_risk.astype(jnp.uint32), ax))
 
         spec = P(ax)
         if skew_plan:
@@ -434,7 +469,7 @@ class HashJoin:
             in_specs = (spec, spec, spec, spec, spec)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(spec, P())))
+            out_specs=(spec, P(), P())))
 
     def _split_key(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
                    skew_plan):
@@ -486,13 +521,14 @@ class HashJoin:
             r, s, cap_r, cap_s, skew_plan, base)
         if cfg.bucket_path:
             # three-program chain: the second radix pass is its own program
-            # timed as SLOCPREP (skew/chunk can't combine with the bucket
-            # path — config-rejected — so the extra shuffle outputs are
-            # exactly the four the LP program consumes)
+            # timed as SLOCPREP; with a skew plan the shuffle's trailing
+            # replicated-hot output joins the LP program's inputs
             lp_args = tuple(shuffled[:4])
+            if skew_plan:
+                lp_args = lp_args + (shuffled[6],)
             fn_lp = self._compile_timed(
                 ("lprep", local_slack) + base,
-                lambda: self._lp_fn(cap_r, cap_s, local_slack
+                lambda: self._lp_fn(cap_r, cap_s, local_slack, skew_plan
                                     ).lower(*lp_args).compile())
             if m:
                 m.start("SLOCPREP")
@@ -502,11 +538,11 @@ class HashJoin:
                                          fence=(lr_blocks, ls_blocks))
             fn_bp = self._compile_timed(
                 ("bprobe", local_slack) + base,
-                lambda: self._bp_fn(cap_r, cap_s, local_slack
+                lambda: self._bp_fn(cap_r, cap_s, local_slack, skew_plan
                                     ).lower(lr_blocks, ls_blocks).compile())
             if m:
                 m.start("JPROC")
-            counts = fn_bp(lr_blocks, ls_blocks)
+            counts, count_risk = fn_bp(lr_blocks, ls_blocks)
             if m:
                 dts["JPROC"] = m.stop("JPROC", fence=counts)
         else:
@@ -517,11 +553,12 @@ class HashJoin:
                                        ).lower(*probe_args).compile())
             if m:
                 m.start("JPROC")
-            counts, local_flag = fn_proc(*probe_args)
+            counts, local_flag, count_risk = fn_proc(*probe_args)
             if m:
                 dts["JPROC"] = m.stop("JPROC", fence=counts)
         flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
-                          int(np.asarray(local_flag)), sflags[4]],
+                          int(np.asarray(local_flag)), sflags[4],
+                          int(np.asarray(count_risk))],
                          dtype=np.uint32)
         return counts, flags, dts
 
@@ -580,38 +617,60 @@ class HashJoin:
                           int(np.asarray(ovf)), sflags[4]], dtype=np.uint32)
         return r_rid, s_rid, valid, flags, dts
 
-    def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int):
-        """Per-bucket capacities of the second radix pass."""
+    def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int,
+                     skew_plan=None):
+        """Per-bucket capacities of the second radix pass.  With a skew
+        plan the replicated hot build side (n * hot_cap gathered tuples)
+        rides through local partitioning too, so the inner total includes
+        it — concentrated in the hot partitions' buckets, hence the same
+        allocation_factor slack plus retry doubling as everywhere else."""
         cfg = self.config
         n = cfg.num_nodes
         nb = cfg.local_partition_count
-        return (cfg.bucket_capacity(n * cap_r, nb) * local_slack,
+        hot_total = n * skew_plan[1] if skew_plan else 0
+        return (cfg.bucket_capacity(n * cap_r + hot_total, nb) * local_slack,
                 cfg.bucket_capacity(n * cap_s, nb) * local_slack)
 
     def _bucket_probe(self, lr_blocks: TupleBatch, ls_blocks: TupleBatch,
                       lcap_r: int, lcap_s: int):
         """Per-bucket counting over capacity-padded bucket blocks; wide keys'
         hi lanes ride the same blocks and the probe's three-key batched row
-        sort compares full (hi, lo) pairs."""
+        sort compares full (hi, lo) pairs.  Returns (counts, count-overflow
+        risk): a bucket's count is statically <= lcap_r * lcap_s, so the
+        runtime max-weight bound (:meth:`_count_risk` rationale) only runs
+        when that product can reach 2**32."""
         nb = self.config.local_partition_count
-        return probe_count_bucketized(
-            lr_blocks.key.reshape(nb, lcap_r),
-            ls_blocks.key.reshape(nb, lcap_s),
-            None if lr_blocks.key_hi is None
-            else lr_blocks.key_hi.reshape(nb, lcap_r),
-            None if ls_blocks.key_hi is None
-            else ls_blocks.key_hi.reshape(nb, lcap_s))
+        args = (lr_blocks.key.reshape(nb, lcap_r),
+                ls_blocks.key.reshape(nb, lcap_s),
+                None if lr_blocks.key_hi is None
+                else lr_blocks.key_hi.reshape(nb, lcap_r),
+                None if ls_blocks.key_hi is None
+                else ls_blocks.key_hi.reshape(nb, lcap_s))
+        if lcap_r * lcap_s < (1 << 32):
+            counts = probe_count_bucketized(*args)
+            # statically-safe False that still carries the counts' device-
+            # varying annotation (a bare constant would trip shard_map's
+            # psum varying check at the flag-assembly site)
+            return counts, jnp.sum(counts) < jnp.uint32(0)
+        counts, maxw = probe_count_bucketized(*args, return_max_weight=True)
+        return counts, maxw > jnp.uint32(0xFFFFFFFF // lcap_s)
 
-    def _lp_fn(self, cap_r: int, cap_s: int, local_slack: int):
+    def _lp_fn(self, cap_r: int, cap_s: int, local_slack: int,
+               skew_plan=None):
         """Local-partitioning program of the bucket-path phase split:
         SLOCPREP, the reference's local-preparation column
-        (Measurements.cpp:176-178; LocalPartitioning task time)."""
+        (Measurements.cpp:176-178; LocalPartitioning task time).  With a
+        skew plan the replicated hot build side arrives as a sixth input
+        and is appended to the inner pass (valid = non-sentinel slots)."""
         cfg = self.config
         ax = cfg.mesh_axes
         fanout = cfg.network_fanout_bits
-        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                           skew_plan)
 
-        def body(rp_batch, rp_valid, sp_batch, sp_valid):
+        def run(rp_batch, rp_valid, sp_batch, sp_valid, hot_batch):
+            rp_batch, rp_valid = self._concat_hot_valid(rp_batch, rp_valid,
+                                                        hot_batch)
             lr = local_partition(rp_batch, rp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_r, "inner")
             ls = local_partition(sp_batch, sp_valid, fanout,
@@ -621,50 +680,96 @@ class HashJoin:
             return lr.blocks, ls.blocks, ovf
 
         spec = P(ax)
+        if skew_plan:
+            def body(rpb, rpv, spb, spv, hot):
+                return run(rpb, rpv, spb, spv, hot)
+            in_specs = (spec,) * 5
+        else:
+            def body(rpb, rpv, spb, spv):
+                return run(rpb, rpv, spb, spv, None)
+            in_specs = (spec,) * 4
         return jax.jit(jax.shard_map(
-            body, mesh=self.mesh, in_specs=(spec, spec, spec, spec),
+            body, mesh=self.mesh, in_specs=in_specs,
             out_specs=(spec, spec, P())))
 
-    def _bp_fn(self, cap_r: int, cap_s: int, local_slack: int):
+    def _bp_fn(self, cap_r: int, cap_s: int, local_slack: int,
+               skew_plan=None):
         """Build-probe program of the bucket-path phase split (JPROC: the
         BuildProbe task time, Measurements.cpp:471-542)."""
         cfg = self.config
         ax = cfg.mesh_axes
-        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                           skew_plan)
 
         def body(lr_blocks, ls_blocks):
-            return self._bucket_probe(lr_blocks, ls_blocks, lcap_r, lcap_s)
+            counts, risk = self._bucket_probe(lr_blocks, ls_blocks,
+                                              lcap_r, lcap_s)
+            return counts, jax.lax.psum(risk.astype(jnp.uint32), ax)
 
         spec = P(ax)
         return jax.jit(jax.shard_map(
-            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=spec))
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=(spec, P())))
+
+    @staticmethod
+    def _count_risk(max_weight, s_hist) -> jnp.ndarray:
+        """True when some partition's uint32 match count could have wrapped.
+
+        count_p <= max_weight * outer_p (each matched outer tuple contributes
+        at most the max inner multiplicity), so the exact integer test
+        ``outer_p > (2**32 - 1) // max_weight`` flags every workload whose
+        count might reach 2**32 — conservatively (a flagged count may still
+        be below the bound), never the other way.  The reference cannot wrap
+        by construction (uint64 RESULT_COUNTER, HashJoin.h:26); uint32
+        device counts + this guard are the no-device-int64 equivalent
+        (VERDICT r3 weak #4)."""
+        limit = jnp.uint32(0xFFFFFFFF) // jnp.maximum(max_weight,
+                                                      jnp.uint32(1))
+        return jnp.any(s_hist > limit)
 
     def _local_process(self, rp_batch: TupleBatch, rp_valid, sp_batch: TupleBatch,
                        sp_valid, sp_pid, hot_batch, cap_r: int, cap_s: int,
-                       local_slack: int):
+                       local_slack: int, s_hist_bound=None):
         """Phase 5/6 — local partitioning + build-probe on the received
         buffers (HashJoin.cpp:131-204).  Traced either inside the fused
         pipeline body or as its own shard_map program when the driver times
         JMPI/JPROC separately (``config.measure_phases``).  Returns
-        (per-partition counts, local overflow)."""
+        (per-partition counts, local overflow, count-overflow risk).
+
+        ``s_hist_bound``: global per-partition outer tuple counts for the
+        overflow-risk bound; the fused pipeline passes the shuffle's s_ghist
+        (free), the split probe program passes None and one histogram pass
+        recomputes it from the received pid lane."""
         cfg = self.config
+        ax = cfg.mesh_axes
         fanout = cfg.network_fanout_bits
         num_p = cfg.network_partition_count
         wide = rp_batch.key_hi is not None
         if cfg.bucket_path:
-            lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack)
+            skew_plan = ((0, hot_batch.size // cfg.num_nodes)
+                         if hot_batch is not None else None)
+            lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                               skew_plan)
+            # the replicated hot build side joins the local radix pass (the
+            # reference's skew locus IS its partitioned probe,
+            # kernels_optimized.cu:301-943)
+            rp_batch, rp_valid = self._concat_hot_valid(rp_batch, rp_valid,
+                                                        hot_batch)
             lr = local_partition(rp_batch, rp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_r, "inner")
             ls = local_partition(sp_batch, sp_valid, fanout,
                                  cfg.local_fanout_bits, lcap_s, "outer")
-            counts = self._bucket_probe(lr.blocks, ls.blocks, lcap_r, lcap_s)
-            local_overflow = lr.overflow + ls.overflow
-        elif cfg.chunk_size:
+            counts, count_risk = self._bucket_probe(
+                lr.blocks, ls.blocks, lcap_r, lcap_s)
+            return counts, lr.overflow + ls.overflow, count_risk
+        if s_hist_bound is None:
+            s_hist_bound = jax.lax.psum(
+                local_histogram(sp_pid, num_p, valid=sp_valid), ax)
+        if cfg.chunk_size:
             # out-of-core discipline (LD kernels): outer slabs under scan
-            counts = probe_count_chunked(
+            counts, maxw = probe_count_chunked(
                 _as_compressed(rp_batch), _as_compressed(sp_batch),
-                sp_pid, num_p, cfg.chunk_size)
-            local_overflow = jnp.uint32(0)
+                sp_pid, num_p, cfg.chunk_size, return_max_weight=True)
         elif wide:
             # 64-bit keys: three-key lexicographic sort-merge on the
             # hi/lo uint32 lanes — no device int64, no x64 requirement
@@ -673,18 +778,19 @@ class HashJoin:
             if hot_batch is not None:
                 rk_lo = jnp.concatenate([rk_lo, hot_batch.key])
                 rk_hi = jnp.concatenate([rk_hi, hot_batch.key_hi])
-            counts = merge_count_wide_per_partition(
-                rk_lo, rk_hi, sp_batch.key, sp_batch.key_hi, fanout)
-            local_overflow = jnp.uint32(0)
+            counts, maxw = merge_count_wide_per_partition(
+                rk_lo, rk_hi, sp_batch.key, sp_batch.key_hi, fanout,
+                return_max_weight=True)
         else:
             rk = rp_batch.key
             if hot_batch is not None:
                 # replicated hot build side joins the local probe; its
                 # padding slots are R sentinels (zero weight)
                 rk = jnp.concatenate([rk, hot_batch.key])
-            counts = merge_count_per_partition(rk, sp_batch.key, fanout)
-            local_overflow = jnp.uint32(0)
-        return counts, local_overflow
+            counts, maxw = merge_count_per_partition(
+                rk, sp_batch.key, fanout, return_max_weight=True)
+        return (counts, jnp.uint32(0),
+                self._count_risk(maxw, s_hist_bound))
 
     def _shuffle(self, r: TupleBatch, s: TupleBatch,
                  win_r: Window, win_s: Window, skew_plan=None):
@@ -696,7 +802,9 @@ class HashJoin:
         split route (operators/skew.py): hot inner tuples leave the shuffle
         and come back replicated via all_gather (``hot_batch``), hot outer
         tuples spread round-robin by rid.  Returns
-        (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad).
+        (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
+        s_ghist) — the trailing global outer histogram feeds the
+        uint32-overflow risk bound (:meth:`_count_risk`).
         """
         cfg = self.config
         ax = cfg.mesh_axes
@@ -802,7 +910,8 @@ class HashJoin:
             bad_r = bad_r | pp_bad   # same failure class: misrouting
         conserve_bad = jax.lax.psum(
             bad_r.astype(jnp.uint32) + bad_s.astype(jnp.uint32), ax)
-        return rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad
+        return (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
+                s_ghist)
 
     def _materialize_fn(self, cap_r: int, cap_s: int, rate_cap: int,
                         skew_plan=None):
@@ -825,8 +934,8 @@ class HashJoin:
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
                 jnp.max(_sentinel_lane(s)) < R_PAD_KEY)
-            rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad = \
-                self._shuffle(r, s, win_r, win_s, skew_plan)
+            (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
+             _s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
             rb = self._concat_hot(rp.batch, hot_batch)
             if cfg.chunk_size:
                 # out-of-core discipline for the materializing probe too
@@ -888,7 +997,9 @@ class HashJoin:
         """Failure breakdown from the pipeline's reduced flag vector.  The
         two shuffle overflows are per relation so a retry grows only the
         window that fell short (the reference sizes them separately,
-        Window.cpp:168-177)."""
+        Window.cpp:168-177).  The trailing count-overflow entry exists only
+        on the counting pipelines (the materializing probe counts matches
+        from host bools — no uint32 accumulator to wrap)."""
         return {
             "key_contract_violations": int(flags[0]),   # nodes with out-of-range keys
             "shuffle_overflow_r_tuples": int(flags[1]),  # inner block capacity shortfall
@@ -896,6 +1007,9 @@ class HashJoin:
             "conservation_violations": int(flags[3]),   # nodes with misrouted counts
             "local_overflow": int(flags[4]),            # bucket / match-cap shortfall
             "hot_overflow": int(flags[5]),              # skew replication buffer shortfall
+            # nodes whose uint32 partition counts could have wrapped
+            # (max_weight x outer_p bound, _count_risk)
+            "count_overflow_risk": int(flags[6]) if len(flags) > 6 else 0,
         }
 
     @staticmethod
@@ -908,7 +1022,8 @@ class HashJoin:
                     or diag["shuffle_overflow_s_tuples"]
                     or diag["local_overflow"] or diag["hot_overflow"])
         return bool(capacity) and (diag["key_contract_violations"] == 0
-                                   and diag["conservation_violations"] == 0)
+                                   and diag["conservation_violations"] == 0
+                                   and diag["count_overflow_risk"] == 0)
 
     def _check_key_width(self, r: TupleBatch, s: TupleBatch) -> None:
         """``config.key_bits`` must match the lanes the batches actually
@@ -1131,8 +1246,11 @@ class HashJoin:
         # inside a later join's phase timers
         return jax.block_until_ready(TupleBatch(key=keys, rid=rids, key_hi=hi))
 
-    # internal alias kept for call-site continuity (tests exercise it too)
-    _place = place
+    def _place(self, rel: Relation) -> TupleBatch:
+        """Alias kept for call-site continuity (tests exercise it too);
+        a def — not a class-attribute binding — so subclass overrides of
+        :meth:`place` are honored (ADVICE r3)."""
+        return self.place(rel)
 
     def join(self, inner: Relation, outer: Relation) -> JoinResult:
         """Join two relation specs (generates shards, shards onto the mesh)."""

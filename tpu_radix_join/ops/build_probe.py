@@ -117,43 +117,57 @@ def probe_count(inner: CompressedBatch, outer: CompressedBatch) -> jnp.ndarray:
 
 
 def _per_partition_counts(r_sorted: jnp.ndarray, s_keys: jnp.ndarray,
-                          pid: jnp.ndarray, num_partitions: int) -> jnp.ndarray:
+                          pid: jnp.ndarray, num_partitions: int):
     """Dual searchsorted against the sorted inner + pid-bincount: the shared
-    counting core of the resident and chunked probes."""
+    counting core of the resident and chunked probes.  Returns
+    ``(counts, max per-outer-tuple count)`` — the latter feeds the driver's
+    uint32-overflow risk bound (hash_join._count_risk)."""
     lo = jnp.searchsorted(r_sorted, s_keys, side="left", method="sort")
     hi = jnp.searchsorted(r_sorted, s_keys, side="right", method="sort")
     per_s = (hi - lo).astype(jnp.uint32)
-    return jnp.bincount(
+    counts = jnp.bincount(
         pid.astype(jnp.int32), weights=per_s, length=num_partitions
     ).astype(jnp.uint32)
+    return counts, jnp.max(per_s)
 
 
 def probe_count_per_partition(
     inner: CompressedBatch, outer: CompressedBatch,
     outer_pid: jnp.ndarray, num_partitions: int,
-) -> jnp.ndarray:
+    return_max_weight: bool = False,
+):
     """Per-partition match counts, uint32 [num_partitions].
 
     Keeps each accumulator < 2**32 so host-side uint64 summation is exact even
     at billions of total matches (see module docstring).  Wide keys carry the
     partition id through the union sort and weight-sum per partition.
+    ``return_max_weight`` also returns the max single-outer-tuple match count
+    (the overflow-risk bound input, see merge_count.merge_count_per_partition).
     """
     if inner.key_rem_hi is not None:
         tag, base, c_r, pid = _wide_union_scan(inner, outer, outer_pid)
         weight = tag.astype(jnp.int32) * (c_r - base)
         # inner slots carry the PAD_RID pid lane but tag=0 zeroes their weight
-        return jnp.bincount(
+        counts = jnp.bincount(
             jnp.minimum(pid, jnp.uint32(num_partitions)).astype(jnp.int32),
             weights=weight.astype(jnp.uint32),
             length=num_partitions + 1)[:num_partitions].astype(jnp.uint32)
-    return _per_partition_counts(sort_unstable(_sort_key(inner)),
-                                 _sort_key(outer), outer_pid, num_partitions)
+        if return_max_weight:
+            return counts, jnp.max(weight).astype(jnp.uint32)
+        return counts
+    counts, maxw = _per_partition_counts(
+        sort_unstable(_sort_key(inner)), _sort_key(outer), outer_pid,
+        num_partitions)
+    if return_max_weight:
+        return counts, maxw
+    return counts
 
 
 def probe_count_chunked(
     inner: CompressedBatch, outer: CompressedBatch,
     outer_pid: jnp.ndarray, num_partitions: int, slab_size: int,
-) -> jnp.ndarray:
+    return_max_weight: bool = False,
+):
     """Per-partition counts with the outer side streamed in ``slab_size``
     slabs under ``lax.scan`` — the distributed realisation of the reference's
     LD (large-data) chunked probe (``iterCount``-indexed kernels,
@@ -189,13 +203,17 @@ def probe_count_chunked(
             lo, hi, pid = slab
             slab_batch = CompressedBatch(key_rem=lo, rid=pid, key_rem_hi=hi)
             return carry, probe_count_per_partition(
-                inner, slab_batch, pid, num_partitions)
+                inner, slab_batch, pid, num_partitions,
+                return_max_weight=True)
 
-        _, per_slab = jax.lax.scan(
+        _, (per_slab, maxw) = jax.lax.scan(
             step_wide, (), (s_lo.reshape(-1, slab_size),
                             s_hi.reshape(-1, slab_size),
                             outer_pid.reshape(-1, slab_size)))
-        return jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
+        counts = jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
+        if return_max_weight:
+            return counts, jnp.max(maxw)
+        return counts
 
     r_sorted = sort_unstable(_sort_key(inner))
     sk = _sort_key(outer)
@@ -214,8 +232,11 @@ def probe_count_chunked(
         return carry, _per_partition_counts(r_sorted, keys, pid,
                                             num_partitions)
 
-    _, per_slab = jax.lax.scan(step, (), (slabs, pids))
-    return jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
+    _, (per_slab, maxw) = jax.lax.scan(step, (), (slabs, pids))
+    counts = jnp.sum(per_slab, axis=0, dtype=jnp.uint32)
+    if return_max_weight:
+        return counts, jnp.max(maxw)
+    return counts
 
 
 # Above this per-bucket slot count, the O(bi*bo) dense compare loses to the
@@ -228,7 +249,8 @@ def probe_count_bucketized(
     inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
     inner_hi: jnp.ndarray | None = None,
     outer_hi: jnp.ndarray | None = None,
-) -> jnp.ndarray:
+    return_max_weight: bool = False,
+):
     """Per-bucket match counts, uint32 [nb], for sentinel-padded key blocks
     inner_blocks [nb, bi] / outer_blocks [nb, bo] (wide keys add the matching
     hi-lane blocks).
@@ -237,22 +259,30 @@ def probe_count_bucketized(
     GPU shared-memory probe analog, kernels.cu:199-246) for tiny buckets,
     else the batched per-bucket sort-merge — O(b log b) rows under one
     batched ``lax.sort``, which keeps the two-level path feasible when
-    capacity-padded buckets are large.
+    capacity-padded buckets are large.  ``return_max_weight`` also returns
+    the max single-outer-tuple match count (overflow-risk bound input;
+    a bucket's count is statically <= bi * bo, so callers only need this
+    when that product can reach 2**32).
     """
     if max(inner_blocks.shape[1], outer_blocks.shape[1]) <= DENSE_BUCKET_LIMIT:
         eq = inner_blocks[:, :, None] == outer_blocks[:, None, :]
         if inner_hi is not None:
             eq &= inner_hi[:, :, None] == outer_hi[:, None, :]
-        return jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
+        counts = jnp.sum(eq.astype(jnp.uint32), axis=(1, 2))
+        if return_max_weight:
+            return counts, jnp.max(jnp.sum(eq.astype(jnp.uint32), axis=1))
+        return counts
     return probe_count_bucketized_merge(inner_blocks, outer_blocks,
-                                        inner_hi, outer_hi)
+                                        inner_hi, outer_hi,
+                                        return_max_weight=return_max_weight)
 
 
 def probe_count_bucketized_merge(
     inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
     inner_hi: jnp.ndarray | None = None,
     outer_hi: jnp.ndarray | None = None,
-) -> jnp.ndarray:
+    return_max_weight: bool = False,
+):
     """Batched per-bucket sort-merge counting (same contract as
     :func:`probe_count_bucketized`).
 
@@ -283,7 +313,10 @@ def probe_count_bucketized_merge(
     # vmap the 1-D weight scan over bucket rows (cumsum/cummax are along the
     # row, independent per bucket)
     weights = jax.vmap(_run_weights)(tag, run_start)
-    return jnp.sum(weights, axis=1, dtype=jnp.uint32)
+    counts = jnp.sum(weights, axis=1, dtype=jnp.uint32)
+    if return_max_weight:
+        return counts, jnp.max(weights)
+    return counts
 
 
 class MaterializedMatches(NamedTuple):

@@ -36,16 +36,20 @@ from tpu_radix_join.ops.merge_count import (
 
 @functools.partial(jax.jit, static_argnames=("num_slabs",))
 def _scan_probe(r_keys: jnp.ndarray, s_keys: jnp.ndarray, num_slabs: int):
-    """Counts for s_keys split into ``num_slabs`` slabs, uint32 [num_slabs]."""
+    """(per-slab counts uint32 [num_slabs], max single-tuple match weight)
+    for s_keys split into ``num_slabs`` slabs.  The max weight feeds the
+    caller's uint32-overflow guard (chunked_join_count)."""
     slabs = s_keys.reshape(num_slabs, -1)
 
     def step(carry, slab):
         # per-slab partial counts; chunked uint32 sums stay overflow-safe
-        c = merge_count_chunks(r_keys, slab, num_chunks=1024)
-        return carry, jnp.sum(c, dtype=jnp.uint32)
+        # as long as the caller-checked weight bound holds
+        c, mw = merge_count_chunks(r_keys, slab, num_chunks=1024,
+                                   return_max_weight=True)
+        return carry, (jnp.sum(c, dtype=jnp.uint32), mw)
 
-    _, per_slab = jax.lax.scan(step, jnp.uint32(0), slabs)
-    return per_slab
+    _, (per_slab, mws) = jax.lax.scan(step, jnp.uint32(0), slabs)
+    return per_slab, jnp.max(mws)
 
 
 @functools.partial(jax.jit, static_argnames=("num_slabs",))
@@ -55,11 +59,12 @@ def _scan_probe_wide(r_lo, r_hi, s_lo, s_hi, num_slabs: int):
 
     def step(carry, slab):
         lo, hi = slab
-        c = merge_count_wide_per_partition(r_lo, r_hi, lo, hi, 0)
-        return carry, jnp.sum(c, dtype=jnp.uint32)
+        c, mw = merge_count_wide_per_partition(r_lo, r_hi, lo, hi, 0,
+                                               return_max_weight=True)
+        return carry, (jnp.sum(c, dtype=jnp.uint32), mw)
 
-    _, per_slab = jax.lax.scan(step, jnp.uint32(0), slabs)
-    return per_slab
+    _, (per_slab, mws) = jax.lax.scan(step, jnp.uint32(0), slabs)
+    return per_slab, jnp.max(mws)
 
 
 def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
@@ -89,16 +94,27 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
             # sentinel in BOTH lanes (the make_padding wide=True contract)
             s_hi = jnp.concatenate(
                 [s_hi, jnp.full((pad,), fill, s_hi.dtype)])
-        per_slab = _scan_probe_wide(r.key, r.key_hi, keys, s_hi,
-                                    (n + pad) // slab_size)
+        per_slab, maxw = _scan_probe_wide(r.key, r.key_hi, keys, s_hi,
+                                          (n + pad) // slab_size)
     else:
-        per_slab = _scan_probe(r.key, keys, (n + pad) // slab_size)
+        per_slab, maxw = _scan_probe(r.key, keys, (n + pad) // slab_size)
+    # uint32-overflow guard: every accumulation window (the per-slab total
+    # and the 1024-position chunk partials inside it) is bounded by
+    # max_weight x window width; a wrapped window would return a wrong count
+    # silently (the reference's uint64 RESULT_COUNTER is immune, HashJoin.h:26)
+    window = max(slab_size, -(-(r.key.shape[0] + slab_size) // 1024))
+    if int(np.asarray(maxw)) > (2**32 - 1) // window:
+        raise OverflowError(
+            f"uint32 count-window overflow risk: max inner multiplicity "
+            f"{int(np.asarray(maxw))} x window {window} can reach 2**32 — "
+            f"shrink slab_size or deduplicate the inner side")
     return int(np.asarray(per_slab).astype(np.uint64).sum())
 
 
 def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                       checkpoint_path: str | None = None,
-                      checkpoint_tag: str = "") -> int:
+                      checkpoint_tag: str = "",
+                      progress: bool = False) -> int:
     """Both sides streamed; each inner chunk is joined against every outer
     chunk exactly once.
 
@@ -168,6 +184,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
             os.fsync(f.fileno())
         os.replace(tmp, checkpoint_path)
 
+    import time as _time
+    t0 = _time.perf_counter()
     last_i = start_i
     for i, r in enumerate(r_chunks):
         if i < start_i:
@@ -178,6 +196,9 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                 continue
             total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]))
             save(i, j + 1, total)
+            if progress:
+                print(f"[grid] pair ({i}, {j}) done, total={total:,}, "
+                      f"t={_time.perf_counter() - t0:.1f}s", flush=True)
         last_i = i + 1
     save(last_i, 0, total, done=True)
     return total

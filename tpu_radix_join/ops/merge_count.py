@@ -79,19 +79,26 @@ def _weights(packed_sorted: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
 
 
 def merge_count_chunks(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
-                       num_chunks: int = 4096) -> jnp.ndarray:
+                       num_chunks: int = 4096,
+                       return_max_weight: bool = False):
     """Match count as uint32 partial sums over fixed position chunks
     (sum on host in uint64).  Safe against uint32 overflow as long as any
     ``(n/num_chunks)``-position window's weights stay < 2**32 — guaranteed
     when per-key inner multiplicity * chunk width < 2**32 (canonical
-    workloads: inner multiplicity ~1)."""
+    workloads: inner multiplicity ~1).  ``return_max_weight`` also returns
+    the max single-outer-tuple match count (uint32 scalar), from which the
+    caller checks that guarantee at runtime (``max_weight * chunk_width <
+    2**32``, see ops/chunked.chunked_join_count)."""
     packed = _sort_unstable(_pack(r_keys, s_keys))
     weight, _ = _weights(packed)
     n = weight.shape[0]
     c = max(1, num_chunks)
     pad = (-n) % c
     weight = jnp.concatenate([weight, jnp.zeros((pad,), jnp.uint32)])
-    return jnp.sum(weight.reshape(c, -1), axis=1, dtype=jnp.uint32)
+    counts = jnp.sum(weight.reshape(c, -1), axis=1, dtype=jnp.uint32)
+    if return_max_weight:
+        return counts, jnp.max(weight)
+    return counts
 
 
 def merge_count_pallas(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
@@ -150,7 +157,8 @@ def _pack_pm(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
 
 def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
                               fanout_bits: int,
-                              impl: str | None = None) -> jnp.ndarray:
+                              impl: str | None = None,
+                              return_max_weight: bool = False):
     """Per-network-partition match counts, uint32 [1 << fanout_bits].
 
     Each partition's count must stay < 2**32 (SURVEY.md §7.4 item 2
@@ -163,6 +171,14 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     fallback: low-bit packing + a weights bincount (a scatter-add XLA
     serializes on TPU — measured 375.7 ms vs ~55 ms total for the Pallas
     path at 16M⋈16M, round 2).
+
+    ``return_max_weight`` also returns the max single-outer-tuple match
+    count (uint32 scalar; free in the Pallas pass, one extra reduction in
+    XLA) — the driver's overflow-risk bound input (hash_join._count_risk):
+    a partition's count is <= max_weight x its outer tuple count, so the
+    guard needs no wider accumulators (the reference is immune via its
+    uint64 RESULT_COUNTER, HashJoin.h:26; uint32 counts + this bound are
+    the no-device-int64 equivalent).
     """
     if impl is None:
         from tpu_radix_join.ops.pallas.merge_scan import pallas_available
@@ -172,8 +188,11 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
         packed = _sort_unstable(_pack(r_keys, s_keys))
         weight, key = _weights(packed)
         pid = (key & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
-        return jnp.bincount(pid, weights=weight,
-                            length=1 << fanout_bits).astype(jnp.uint32)
+        counts = jnp.bincount(pid, weights=weight,
+                              length=1 << fanout_bits).astype(jnp.uint32)
+        if return_max_weight:
+            return counts, jnp.max(weight)
+        return counts
     from tpu_radix_join.ops.pallas.merge_scan import TILE, merge_scan_partitions
     packed = _sort_unstable(_pack_pm(r_keys, s_keys, fanout_bits))
     pad = (-packed.shape[0]) % TILE
@@ -182,9 +201,12 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
         # pid and remainder), >= every packed value, so sortedness holds
         packed = jnp.concatenate(
             [packed, jnp.full((pad,), _S_PACK_PAD, jnp.uint32)])
-    return merge_scan_partitions(
+    counts, maxw = merge_scan_partitions(
         packed, num_partitions=1 << fanout_bits,
         interpret=(impl == "pallas_interpret"))
+    if return_max_weight:
+        return counts, maxw
+    return counts
 
 
 def _rotate_pid(lo: jnp.ndarray, fanout_bits: int) -> jnp.ndarray:
@@ -203,7 +225,8 @@ def merge_count_wide_per_partition(
     s_lo: jnp.ndarray, s_hi: jnp.ndarray,
     fanout_bits: int,
     impl: str | None = None,
-) -> jnp.ndarray:
+    return_max_weight: bool = False,
+):
     """64-bit-key match counting without 64-bit arithmetic.
 
     TPU int64 is limited/slow (SURVEY.md §7.4 item 3), so wide keys ride as
@@ -220,6 +243,7 @@ def merge_count_wide_per_partition(
 
     Pad sentinels sit in BOTH lanes (make_padding wide=True), and R/S pads
     differ in the hi lane, so padding contributes zero weight on either path.
+    ``return_max_weight`` as in :func:`merge_count_per_partition`.
     """
     if impl is None:
         from tpu_radix_join.ops.pallas.merge_scan import pallas_available
@@ -242,9 +266,12 @@ def merge_count_wide_per_partition(
             lo_rot = jnp.concatenate([lo_rot, ones])
             hi = jnp.concatenate([hi, ones])
             tag = jnp.concatenate([tag, jnp.ones((pad,), jnp.uint32)])
-        return merge_scan_partitions_wide(
+        counts, maxw = merge_scan_partitions_wide(
             lo_rot, hi, tag, num_partitions=1 << fanout_bits,
             interpret=(impl == "pallas_interpret"))
+        if return_max_weight:
+            return counts, maxw
+        return counts
 
     hi, lo, tag = _sort_lex_unstable(hi, lo, tag, num_keys=3)
     prev_hi = jnp.concatenate([jnp.full((1,), 0xFFFFFFFF, jnp.uint32), hi[:-1]])
@@ -255,5 +282,8 @@ def merge_count_wide_per_partition(
     run_start = (hi != prev_hi) | (lo != prev_lo)
     weight = _run_weights(tag, run_start)
     pid = (lo & jnp.uint32((1 << fanout_bits) - 1)).astype(jnp.int32)
-    return jnp.bincount(pid, weights=weight,
-                        length=1 << fanout_bits).astype(jnp.uint32)
+    counts = jnp.bincount(pid, weights=weight,
+                          length=1 << fanout_bits).astype(jnp.uint32)
+    if return_max_weight:
+        return counts, jnp.max(weight)
+    return counts

@@ -138,8 +138,8 @@ def _kernel(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref):
     prev_key_ref[0] = prev
 
 
-def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
-                       *, num_partitions: int, pid_shift: int):
+def _kernel_partitions(packed_ref, out_ref, maxw_ref, c_r_ref, base_ref,
+                       prev_key_ref, *, num_partitions: int, pid_shift: int):
     """Merge-weight scan fused with per-partition accumulation.
 
     Input is sorted in PARTITION-MAJOR packing (pid in the top bits, see
@@ -147,7 +147,9 @@ def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
     pid range; the per-partition masked reductions are ``pl.when``-guarded on
     that range, so only ~2 of them execute per tile regardless of the fanout.
     Accumulation is int32 (wraps identically to the uint32 contract); the
-    caller bitcasts.
+    caller bitcasts.  ``maxw_ref`` carries the max single-tuple match weight
+    (max inner multiplicity among matched outer tuples) — the quantity the
+    driver's uint32-overflow risk bound needs (hash_join._count_risk).
     """
     t = pl.program_id(0)
 
@@ -155,6 +157,7 @@ def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
     def _init():
         for p in range(num_partitions):
             out_ref[p] = jnp.int32(0)
+        maxw_ref[0] = jnp.int32(0)
         c_r_ref[0] = jnp.int32(0)
         base_ref[0] = jnp.int32(0)
         prev_key_ref[0] = jnp.int32(-1)
@@ -162,6 +165,7 @@ def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
     packed = packed_ref[:]
     weight, _, c_r, base, prev = _tile_scan(
         packed, c_r_ref[0], base_ref[0], prev_key_ref[0])
+    maxw_ref[0] = jnp.maximum(maxw_ref[0], jnp.max(jnp.max(weight, axis=0)))
     if num_partitions == 1:
         out_ref[0] = out_ref[0] + jnp.sum(jnp.sum(weight, axis=0))
     else:
@@ -181,7 +185,7 @@ def _kernel_partitions(packed_ref, out_ref, c_r_ref, base_ref, prev_key_ref,
 
 @functools.partial(jax.jit, static_argnames=("num_partitions", "interpret"))
 def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
-                          interpret: bool = False) -> jnp.ndarray:
+                          interpret: bool = False):
     """Per-partition match counts (uint32 [num_partitions]) in ONE pass over
     a partition-major sorted packed array (merge_count._pack_pm layout:
     pid in the top log2(num_partitions) bits, then key remainder, then the
@@ -192,6 +196,11 @@ def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
     kernel's whole post-sort phase is ~one HBM pass).  Length must be a tile
     multiple (pad post-sort with 0xFFFFFFFF = the S pad, which sorts last and
     carries zero weight).
+
+    Returns ``(counts, max_weight)``: the second output is the max
+    single-outer-tuple match count (uint32 scalar), accumulated in the same
+    pass — the driver's uint32-overflow risk bound consumes it
+    (hash_join._count_risk).
     """
     n = packed_sorted.shape[0]
     if n % TILE:
@@ -203,14 +212,17 @@ def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
     kernel = functools.partial(_kernel_partitions,
                                num_partitions=num_partitions,
                                pid_shift=pid_shift)
-    out = pl.pallas_call(
+    out, maxw = pl.pallas_call(
         kernel,
         grid=(num_tiles,),
         in_specs=[pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
                                memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec((num_partitions,), lambda t: (0,),
-                               memory_space=pltpu.SMEM),
-        out_shape=out_struct((num_partitions,), jnp.int32, packed_sorted),
+        out_specs=(pl.BlockSpec((num_partitions,), lambda t: (0,),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((1,), lambda t: (0,),
+                                memory_space=pltpu.SMEM)),
+        out_shape=(out_struct((num_partitions,), jnp.int32, packed_sorted),
+                   out_struct((1,), jnp.int32, packed_sorted)),
         scratch_shapes=[
             pltpu.SMEM((1,), jnp.int32),
             pltpu.SMEM((1,), jnp.int32),
@@ -218,10 +230,11 @@ def merge_scan_partitions(packed_sorted: jnp.ndarray, *, num_partitions: int,
         ],
         interpret=interpret,
     )(packed_sorted.reshape(num_tiles * ROWS, LANES))
-    return jax.lax.bitcast_convert_type(out, jnp.uint32)
+    return (jax.lax.bitcast_convert_type(out, jnp.uint32),
+            maxw[0].astype(jnp.uint32))
 
 
-def _kernel_partitions_wide(lo_ref, hi_ref, tag_ref, out_ref,
+def _kernel_partitions_wide(lo_ref, hi_ref, tag_ref, out_ref, maxw_ref,
                             c_r_ref, base_ref, prev_lo_ref, prev_hi_ref,
                             *, num_partitions: int, pid_shift: int):
     """Wide-key (hi/lo lane) variant of :func:`_kernel_partitions`.
@@ -243,6 +256,7 @@ def _kernel_partitions_wide(lo_ref, hi_ref, tag_ref, out_ref,
     def _init():
         for p in range(num_partitions):
             out_ref[p] = jnp.int32(0)
+        maxw_ref[0] = jnp.int32(0)
         c_r_ref[0] = jnp.int32(0)
         base_ref[0] = jnp.int32(0)
         prev_lo_ref[0] = int32_min
@@ -271,6 +285,7 @@ def _kernel_partitions_wide(lo_ref, hi_ref, tag_ref, out_ref,
     base_at_start = jnp.where(run_start, c_r - is_r, 0)
     base_run = jnp.maximum(_tile_cummax(base_at_start), carry_base)
     weight = is_s * (c_r - base_run)
+    maxw_ref[0] = jnp.maximum(maxw_ref[0], jnp.max(jnp.max(weight, axis=0)))
 
     if num_partitions == 1:
         out_ref[0] = out_ref[0] + jnp.sum(jnp.sum(weight, axis=0))
@@ -299,12 +314,13 @@ def merge_scan_partitions_wide(lo_rot_sorted: jnp.ndarray,
                                hi_sorted: jnp.ndarray,
                                tag_sorted: jnp.ndarray, *,
                                num_partitions: int,
-                               interpret: bool = False) -> jnp.ndarray:
+                               interpret: bool = False):
     """Per-partition match counts for 64-bit keys in one pass over the
     three-lane partition-major sort order (see merge_count's wide Pallas
     path).  Lengths must be a tile multiple (pad post-sort with the all-ones
     triple (0xFFFFFFFF, 0xFFFFFFFF, 1) — the wide S pad image, lexicographic
-    maximum, zero weight)."""
+    maximum, zero weight).  Returns ``(counts, max_weight)`` as
+    :func:`merge_scan_partitions` does."""
     n = lo_rot_sorted.shape[0]
     if n % TILE:
         raise ValueError(f"length {n} must be a multiple of {TILE}")
@@ -317,19 +333,23 @@ def merge_scan_partitions_wide(lo_rot_sorted: jnp.ndarray,
                                pid_shift=pid_shift)
     spec = pl.BlockSpec((ROWS, LANES), lambda t: (t, 0),
                         memory_space=pltpu.VMEM)
-    out = pl.pallas_call(
+    out, maxw = pl.pallas_call(
         kernel,
         grid=(num_tiles,),
         in_specs=[spec, spec, spec],
-        out_specs=pl.BlockSpec((num_partitions,), lambda t: (0,),
-                               memory_space=pltpu.SMEM),
-        out_shape=out_struct((num_partitions,), jnp.int32, lo_rot_sorted),
+        out_specs=(pl.BlockSpec((num_partitions,), lambda t: (0,),
+                                memory_space=pltpu.SMEM),
+                   pl.BlockSpec((1,), lambda t: (0,),
+                                memory_space=pltpu.SMEM)),
+        out_shape=(out_struct((num_partitions,), jnp.int32, lo_rot_sorted),
+                   out_struct((1,), jnp.int32, lo_rot_sorted)),
         scratch_shapes=[pltpu.SMEM((1,), jnp.int32) for _ in range(4)],
         interpret=interpret,
     )(lo_rot_sorted.reshape(num_tiles * ROWS, LANES),
       hi_sorted.reshape(num_tiles * ROWS, LANES),
       tag_sorted.reshape(num_tiles * ROWS, LANES))
-    return jax.lax.bitcast_convert_type(out, jnp.uint32)
+    return (jax.lax.bitcast_convert_type(out, jnp.uint32),
+            maxw[0].astype(jnp.uint32))
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

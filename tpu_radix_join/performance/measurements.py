@@ -42,6 +42,12 @@ SNETCOMPL = "SNETCOMPL"    # network completion wait
 SLOCPREP = "SLOCPREP"      # local preparation
 
 MWINWAIT = "MWINWAIT"      # time spent on retried (undersized-window) attempts
+JCOMPILE = "JCOMPILE"      # XLA compilation (no reference analog: it has none
+                           # at runtime; kept out of every phase column)
+SDISPATCH = "SDISPATCH"    # per-program dispatch round-trip floor (not a
+                           # cumulative phase: the amortized cost of ONE
+                           # empty-program dispatch through the host
+                           # attachment, measured once per run)
 
 _GATHER_BUF_BYTES = 1 << 16   # fixed allgather slot per process (gather_all)
 
@@ -99,6 +105,15 @@ class Measurements:
     def add_time_us(self, key: str, us: float) -> None:
         self.times_us[key] += us
 
+    def exclude_from_running(self, us: float) -> None:
+        """Shift every currently-running timer's start forward by ``us`` so an
+        interval that must not land in their columns (XLA compilation — the
+        reference's phase timers contain no compile because none exists at
+        runtime, Measurements.cpp:137-141) is excluded from whatever spans it
+        (JTOTAL, SWINALLOC).  JCOMPILE keeps the time under its own tag."""
+        for k in self._starts:
+            self._starts[k] += us / 1e6
+
     def incr(self, key: str, by: int = 1) -> None:
         self.counters[key] += by
 
@@ -134,6 +149,26 @@ class Measurements:
                 cnt = self.counters.get(cnt_key, 0)
                 if cnt:
                     self.counters[rate_key] = int(cnt / (jh / 1e6))
+
+    def measure_dispatch_floor(self, iters: int = 20) -> float:
+        """Record SDISPATCH: the amortized round-trip of dispatching one
+        trivial program and fencing it — the floor every split-phase column
+        (JMPI/JHIST/SLOCPREP/JPROC) pays per program through the host
+        attachment.  On a tunnel-attached chip this is ~100ms and dominates
+        small split columns (BASELINE r3 phase tables); readers subtract it
+        to see work net of dispatch.  The reference keeps comparable
+        "special" timers for accounting honesty (Measurements.cpp:176-178).
+        Stored as a floor (assignment, not +=); returns microseconds."""
+        import jax.numpy as jnp
+        fn = jax.jit(lambda x: x + jnp.uint32(1))
+        x = jnp.zeros((8,), jnp.uint32)
+        jax.block_until_ready(fn(x))   # compile outside the timed loop
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            jax.block_until_ready(fn(x))
+        us = (time.perf_counter() - t0) / iters * 1e6
+        self.times_us[SDISPATCH] = us
+        return us
 
     # ------------------------------------------------------- memory / tracing
     def memory_utilization(self) -> Dict[str, int]:
