@@ -28,12 +28,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
-# sitecustomize pins the platform default at interpreter start (the live-TPU
-# tunnel); honor an explicit JAX_PLATFORMS override — e.g. CPU smoke runs —
-# the same way bench.py's probe child does
-_p = os.environ.get("JAX_PLATFORMS")
-if _p:
-    jax.config.update("jax_platforms", _p)
+from tpu_radix_join.utils.platform import apply_platform_override
+
+apply_platform_override()   # honor JAX_PLATFORMS (e.g. CPU smoke runs)
 
 from tpu_radix_join.data.relation import Relation
 from tpu_radix_join.data.streaming import stream_chunks_device

@@ -68,7 +68,10 @@ def test_cli_generation_modes(capsys):
         out = capsys.readouterr().out
         assert rc == 0, out
         assert "[RESULTS] Tuples: 8192" in out
-    import pytest
-    with pytest.raises(ValueError, match="on-device"):
-        main(["--tuples-per-node", "2048", "--nodes", "4",
-              "--generation", "device", "--outer-kind", "zipf"])
+    # zipf generates on device since r4 (integer-table sampler): the
+    # device-forced zipf run matches the unique⋈zipf covered-domain oracle
+    rc = main(["--tuples-per-node", "2048", "--nodes", "4",
+               "--generation", "device", "--outer-kind", "zipf"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    assert "[RESULTS] Expected: 8192 (OK)" in out

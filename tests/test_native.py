@@ -54,12 +54,14 @@ def test_native_unique_is_permutation():
 
 
 def test_native_zipf_matches_numpy_twin():
-    from tpu_radix_join.data.relation import Relation, zipf_cdf_table, zipf_keys_np
+    from tpu_radix_join.data.relation import (Relation, zipf_keys_np,
+                                              zipf_tables)
     rel = Relation(4096, 2, "zipf", zipf_theta=0.75, key_domain=1024, seed=9)
     for node in (0, 1):
         native_keys, _ = rel.shard_np(node)
-        cdf = zipf_cdf_table(0.75, 1024)
-        twin = zipf_keys_np(node * rel.local_size, rel.local_size, cdf, 1024, 0.75, 9)
+        head_cdf, tail_keys = zipf_tables(0.75, 1024)
+        twin = zipf_keys_np(node * rel.local_size, rel.local_size, head_cdf,
+                            tail_keys, 1024, 9)
         np.testing.assert_array_equal(native_keys, twin)
     # skew sanity: rank 0 must dominate
     keys = np.concatenate([rel.shard_np(i)[0] for i in range(2)])
@@ -68,15 +70,16 @@ def test_native_zipf_matches_numpy_twin():
 
 def test_native_zipf_covers_large_domains():
     # domains beyond the 65536-rank table must still be reachable via the
-    # continuous power-law tail (and match the numpy twin bit-for-bit)
-    from tpu_radix_join.data.relation import Relation, zipf_cdf_table, zipf_keys_np
+    # interpolated power-law tail (and match the numpy twin bit-for-bit)
+    from tpu_radix_join.data.relation import (Relation, zipf_keys_np,
+                                              zipf_tables)
     domain = 1 << 20
     rel = Relation(1 << 16, 1, "zipf", zipf_theta=0.75, key_domain=domain, seed=4)
     keys, _ = rel.shard_np(0)
     assert keys.max() > 65536          # tail ranks appear
     assert keys.max() < domain
-    cdf = zipf_cdf_table(0.75, domain)
-    twin = zipf_keys_np(0, 1 << 16, cdf, domain, 0.75, 4)
+    head_cdf, tail_keys = zipf_tables(0.75, domain)
+    twin = zipf_keys_np(0, 1 << 16, head_cdf, tail_keys, domain, 4)
     np.testing.assert_array_equal(keys, twin)
 
 

@@ -91,11 +91,11 @@ class JoinConfig:
     # --- data placement --------------------------------------------------------
     # How Relation-driven entry points materialize shards (SURVEY.md §7.4
     # item 5): "auto" generates on device when the relation kind supports it
-    # (unique/modulo — no host materialization, no host->device transfer) and
-    # falls back to host generation + device_put otherwise (zipf's f64 CDF);
-    # "host" forces the host path (the bit-identical twin, useful for
-    # debugging); "device" requires on-device generation and raises for
-    # unsupported kinds.
+    # — since r4 that is every kind (unique/modulo: Feistel walk / residues;
+    # zipf: integer-table sampler), all bit-identical to the host twins —
+    # with host generation + device_put as the fallback for future kinds;
+    # "host" forces the host path (useful for debugging); "device" requires
+    # on-device generation.
     generation: str = "auto"
 
     # --- instrumentation -------------------------------------------------------

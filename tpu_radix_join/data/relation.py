@@ -69,44 +69,108 @@ def key_hi_lane(key: jnp.ndarray) -> jnp.ndarray:
             | jnp.uint32(_HI_LANE_LOW))
 
 
-def _splitmix64(x: np.ndarray) -> np.ndarray:
-    """Vectorized splitmix64 — must match datagen.cc exactly."""
-    x = (x + np.uint64(0x9E3779B97F4A7C15))
-    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
-    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
-    return x ^ (x >> np.uint64(31))
+ZIPF_TAIL_POINTS = 4096
+_ZIPF_V_SALT = 0x9E3779B9   # second-draw salt for the tail interpolation
 
 
-def zipf_cdf_table(theta: float, domain: int) -> np.ndarray:
-    """Unnormalized Zipf(1+theta) rank CDF, float64 [min(domain, 65536)].
+def zipf_tables(theta: float, domain: int):
+    """Integer-scaled Zipf(1+theta) sampling tables, shared VERBATIM by the
+    numpy, native (datagen.cc), and device samplers — after this point every
+    sampler runs identical uint32 arithmetic, so all three are bit-identical
+    (including on TPU, which has no f64: the f64 below runs once, on host,
+    at table-build time).
 
-    Built once in Python and shared verbatim with the native sampler so both
-    paths draw bit-identical keys."""
+      head_cdf: uint32 [min(domain, 65536)] — rank CDF scaled to 2**32
+        (head-rank probabilities exact to 2**-32).
+      tail_keys: uint32 [4097] — piecewise-linear inverse CDF of the
+        continuous power-law tail for ranks past the head table (the same
+        tail the r3 f64 sampler inverted exactly; the 4096-segment linear
+        approximation error is < one segment width, on ranks whose
+        individual probabilities are < 65536**-(1+theta)).
+    """
     table = min(domain, _ZIPF_TABLE_MAX)
     ranks = np.arange(1, table + 1, dtype=np.float64)
-    return np.cumsum(1.0 / np.power(ranks, 1.0 + theta))
-
-
-def zipf_keys_np(start: int, count: int, cdf: np.ndarray, domain: int,
-                 theta: float, seed: int) -> np.ndarray:
-    """numpy twin of datagen.cc fill_zipf (same table, same index hashing,
-    same continuous power-law tail for ranks past the table)."""
-    table = len(cdf)
+    cdf = np.cumsum(1.0 / np.power(ranks, 1.0 + theta))
     head = cdf[-1]
     t_pow = float(table) ** -theta
     d_pow = float(domain) ** -theta
     tail = (t_pow - d_pow) / theta if domain > table else 0.0
-    idx = np.uint64(seed) ^ np.arange(start, start + count, dtype=np.uint64)
+    total = head + tail
+    head_cdf = np.minimum(np.floor(cdf / total * 4294967296.0),
+                          4294967295.0).astype(np.uint32)
+    if domain > table:
+        f = (np.arange(ZIPF_TAIL_POINTS + 1, dtype=np.float64)
+             / ZIPF_TAIL_POINTS)
+        x = np.power(t_pow - f * (t_pow - d_pow), -1.0 / theta)
+        tail_keys = np.clip(np.floor(x), table, domain - 1).astype(np.uint32)
+    else:
+        # unused (no tail); a constant table keeps every sampler shape-stable
+        tail_keys = np.full(ZIPF_TAIL_POINTS + 1, table - 1, np.uint32)
+    return head_cdf, tail_keys
+
+
+def zipf_keys_np(start: int, count: int, head_cdf: np.ndarray,
+                 tail_keys: np.ndarray, domain: int, seed: int) -> np.ndarray:
+    """numpy Zipf sampler twin (of datagen.cc fill_zipf and
+    :func:`_zipf_range`): pure uint32 ops on the shared tables.
+
+    Draw: u = mix32(index ^ mix32(seed)); head ranks by upper-bound search
+    of the scaled CDF; tail ranks by linear interpolation of ``tail_keys``
+    with a second mixed draw supplying (segment, fraction) bits."""
+    table = len(head_cdf)
+    idx = np.arange(start, start + count, dtype=np.uint32)
     with np.errstate(over="ignore"):
-        u = (_splitmix64(idx) >> np.uint64(11)).astype(np.float64) / 9007199254740992.0
-    target = u * (head + tail)
-    key = np.searchsorted(cdf, np.minimum(target, head), side="left").astype(np.uint64)
-    in_tail = target > head
-    if tail > 0.0 and in_tail.any():
-        frac = (target[in_tail] - head) / tail
-        x = np.power(t_pow - frac * (t_pow - d_pow), -1.0 / theta)
-        key[in_tail] = np.clip(x.astype(np.uint64), table, domain - 1)
-    return key.astype(np.uint32)
+        u = mix32_np(idx ^ mix32_np(np.uint32(seed & 0xFFFFFFFF)))
+        key = np.minimum(
+            np.searchsorted(head_cdf, u, side="right"),
+            table - 1).astype(np.uint32)
+        if domain > table:
+            v = mix32_np(u ^ np.uint32(_ZIPF_V_SALT))
+            j = (v >> np.uint32(20)).astype(np.int64)
+            frac = (v >> np.uint32(8)) & np.uint32(0xFFF)
+            tk = tail_keys[j]
+            d = tail_keys[j + 1] - tk
+            interp = ((d >> np.uint32(12)) * frac
+                      + (((d & np.uint32(0xFFF)) * frac) >> np.uint32(12)))
+            s = tk + interp
+            # uint32-wrap clamp (domain may sit within 4093 of 2**32):
+            # a wrapped sum is detectable as s < tk — same test on device
+            k_tail = np.where(s < tk, np.uint32(domain - 1),
+                              np.minimum(s, np.uint32(domain - 1)))
+            key = np.where(u >= head_cdf[-1], k_tail, key)
+    return key
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "domain", "seed", "wide"))
+def _zipf_range(start, n: int, head_cdf: jnp.ndarray, tail_keys: jnp.ndarray,
+                domain: int, seed: int, wide: bool):
+    """Device Zipf sampler twin — bit-identical to :func:`zipf_keys_np`
+    (same tables, same uint32 ops; ``searchsorted`` results are
+    method-independent).  ``start`` may be a Python int or traced uint32.
+    Returns ``(key[, key_hi], rid)`` like ``_device_range``."""
+    rid = jnp.arange(n, dtype=jnp.uint32) + jnp.uint32(start)
+    table = head_cdf.shape[0]
+    u = mix32(rid ^ mix32(jnp.uint32(seed & 0xFFFFFFFF)))
+    # method="sort": one combined sort instead of per-element binary-search
+    # gathers — the TPU-friendly lowering (result is exact either way)
+    key = jnp.minimum(
+        jnp.searchsorted(head_cdf, u, side="right", method="sort"),
+        table - 1).astype(jnp.uint32)
+    if domain > table:
+        v = mix32(u ^ jnp.uint32(_ZIPF_V_SALT))
+        j = (v >> jnp.uint32(20)).astype(jnp.int32)
+        frac = (v >> jnp.uint32(8)) & jnp.uint32(0xFFF)
+        tk = tail_keys[j]
+        d = tail_keys[j + 1] - tk
+        interp = ((d >> jnp.uint32(12)) * frac
+                  + (((d & jnp.uint32(0xFFF)) * frac) >> jnp.uint32(12)))
+        s = tk + interp
+        # uint32-wrap clamp, twin of the numpy sampler's
+        k_tail = jnp.where(s < tk, jnp.uint32(domain - 1),
+                           jnp.minimum(s, jnp.uint32(domain - 1)))
+        key = jnp.where(u >= head_cdf[table - 1], k_tail, key)
+    return (key, key_hi_lane(key), rid) if wide else (key, rid)
 
 
 def _feistel_round_np(l, r, k, half_bits):
@@ -260,6 +324,12 @@ class Relation:
         self.modulo = modulo
         self.zipf_theta = zipf_theta
         self.key_domain = int(key_domain) if key_domain else self.global_size
+        self._zipf_cache = None   # (head_cdf, tail_keys), built on first use
+
+    def _zipf_tables_cached(self):
+        if self._zipf_cache is None:
+            self._zipf_cache = zipf_tables(self.zipf_theta, self.key_domain)
+        return self._zipf_cache
 
     @property
     def local_size(self) -> int:
@@ -324,15 +394,17 @@ class Relation:
             key[:] = rid % np.uint32(self.modulo)
             return key, rid
 
-        # zipf: skewed draw over [0, key_domain)
-        cdf = zipf_cdf_table(self.zipf_theta, self.key_domain)
+        # zipf: skewed draw over [0, key_domain) — integer tables shared
+        # verbatim with the native and device samplers (zipf_tables)
+        head_cdf, tail_keys = self._zipf_tables_cached()
         if lib is not None:
+            p_u32 = ctypes.POINTER(ctypes.c_uint32)
             lib.fill_zipf(
-                kp, lo, n, cdf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                len(cdf), self.key_domain, ctypes.c_double(self.zipf_theta),
+                kp, lo, n, head_cdf.ctypes.data_as(p_u32), len(head_cdf),
+                tail_keys.ctypes.data_as(p_u32), self.key_domain,
                 self.seed, num_threads)
             return key, rid
-        key[:] = zipf_keys_np(lo, n, cdf, self.key_domain, self.zipf_theta,
+        key[:] = zipf_keys_np(lo, n, head_cdf, tail_keys, self.key_domain,
                               self.seed)
         return key, rid
 
@@ -351,25 +423,33 @@ class Relation:
         return key, rid
 
     # ---------------------------------------------------------------- device
+    def zipf_range_device(self, start, n: int):
+        """Device Zipf lanes for the global index range [start, start+n)
+        (``(key[, key_hi], rid)``), bit-identical to the host sampler —
+        the tables are host-built once (cached) and shipped as uint32
+        constants; all sampling arithmetic runs on device."""
+        head_cdf, tail_keys = self._zipf_tables_cached()
+        return _zipf_range(np.uint32(start), n, jnp.asarray(head_cdf),
+                           jnp.asarray(tail_keys), self.key_domain,
+                           self.seed, self.key_bits == 64)
+
     def shard(self, node: int) -> TupleBatch:
-        """One node's shard as a device TupleBatch (generation on device for
-        the unique/modulo kinds; host fallback otherwise)."""
+        """One node's shard as a device TupleBatch — every kind generates on
+        device (unique/modulo: Feistel walk / residues; zipf since r4: the
+        integer-table sampler)."""
         lo = node * self.local_size
-        if self.kind in ("unique", "modulo"):
+        if self.kind == "zipf":
+            out = self.zipf_range_device(lo, self.local_size)
+        else:
             out = device_range(
                 lo, self.local_size, self.global_size, self.seed,
                 self.modulo if self.kind == "modulo" else None,
                 self.key_bits == 64)
-            if self.key_bits == 64:
-                key, hi, rid = out
-                return TupleBatch(key=key, rid=rid, key_hi=hi)
-            key, rid = out
-            return TupleBatch(key=key, rid=rid, key_hi=None)
-        key_np, _ = self.fill_np(lo, self.local_size)
-        key = jnp.asarray(key_np)
-        rid = jnp.arange(lo, lo + self.local_size, dtype=jnp.uint32)
-        hi = key_hi_lane(key) if self.key_bits == 64 else None
-        return TupleBatch(key=key, rid=rid, key_hi=hi)
+        if self.key_bits == 64:
+            key, hi, rid = out
+            return TupleBatch(key=key, rid=rid, key_hi=hi)
+        key, rid = out
+        return TupleBatch(key=key, rid=rid, key_hi=None)
 
     def generate_sharded(self, mesh, axes) -> Optional[TupleBatch]:
         """The whole relation generated **on device**, sharded over ``mesh``
@@ -378,13 +458,13 @@ class Relation:
         "generate sharded on-device rather than host-side like
         Relation::fillUniqueValues").
 
-        Bit-identical to the ``shard_np`` host path for the supported kinds
-        ("unique": same Feistel rounds + cycle walk; "modulo": same dense-rid
-        residues).  Returns ``None`` for "zipf", whose float64 CDF inversion
-        has no TPU twin (no f64 on device) — callers fall back to host
-        generation.
-        """
-        if self.kind not in ("unique", "modulo"):
+        Bit-identical to the ``shard_np`` host path for every kind
+        ("unique": same Feistel rounds + cycle walk; "modulo": same
+        dense-rid residues; "zipf" since r4: the integer-table sampler —
+        host-built uint32 tables, device uint32 arithmetic).  Returns
+        ``None`` only for kinds without a device generator (none today;
+        the hook remains for future kinds)."""
+        if self.kind not in ("unique", "modulo", "zipf"):
             return None
         n = int(np.prod(mesh.devices.shape))
         if n != self.num_nodes:
@@ -394,12 +474,21 @@ class Relation:
         wide = self.key_bits == 64
         gs = self.global_size
         seed = self.seed
+        kind = self.kind
         modulo = self.modulo if self.kind == "modulo" else None
+        if kind == "zipf":
+            head_cdf, tail_keys = self._zipf_tables_cached()
+            c_dev = jnp.asarray(head_cdf)
+            tk_dev = jnp.asarray(tail_keys)
+            domain = self.key_domain
         from jax.sharding import PartitionSpec
 
         def gen():
             i = jax.lax.axis_index(axes)   # flat rank over the (maybe
             lo = i.astype(jnp.uint32) * jnp.uint32(local)   # hierarchical) mesh
+            if kind == "zipf":
+                return _zipf_range(lo, local, c_dev, tk_dev, domain, seed,
+                                   wide)
             return _device_range(lo, local, gs, seed, modulo, wide)
 
         spec = PartitionSpec(axes)

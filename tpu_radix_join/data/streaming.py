@@ -95,20 +95,15 @@ def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
 def stream_chunks_device(rel: Relation, node: int,
                          chunk_tuples: int) -> Iterator[TupleBatch]:
     """Yield one node's shard as **device-generated** TupleBatches — the
-    at-scale twin of :func:`stream_chunks` for kinds with on-device
-    generators (unique / modulo): each chunk's keys are computed on device
-    from its global index range (same Feistel walk / residues, bit-identical
+    at-scale twin of :func:`stream_chunks`: each chunk's keys are computed
+    on device from its global index range (unique/modulo: same Feistel walk
+    / residues; zipf since r4: the integer-table sampler — all bit-identical
     to the host stream), so the host materializes and transfers nothing
     (SURVEY.md §7.4 item 5).  Out-of-core grid joins stay compute-bound even
-    on transfer-starved attachments.  The zipf kind (host-only f64 CDF)
-    raises — use :func:`stream_chunks`.
+    on transfer-starved attachments.
     """
     if chunk_tuples < 1:
         raise ValueError("chunk_tuples must be >= 1")
-    if rel.kind not in ("unique", "modulo"):
-        raise ValueError(
-            f"relation kind {rel.kind!r} has no on-device generator — "
-            f"use stream_chunks")
     local = rel.local_size
     base = node * local
     num_chunks = -(-local // chunk_tuples)
@@ -117,7 +112,11 @@ def stream_chunks_device(rel: Relation, node: int,
     for i in range(num_chunks):
         start = base + i * chunk_tuples
         n = min(chunk_tuples, base + local - start)
-        out = device_range(start, n, rel.global_size, rel.seed, modulo, wide)
+        if rel.kind == "zipf":
+            out = rel.zipf_range_device(start, n)
+        else:
+            out = device_range(start, n, rel.global_size, rel.seed, modulo,
+                               wide)
         if wide:
             key, hi, rid = out
             yield TupleBatch(key=key, rid=rid, key_hi=hi)

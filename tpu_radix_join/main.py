@@ -80,17 +80,11 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
 
-    import os
-
     import jax
 
-    # sitecustomize pins the platform default at interpreter start (live-TPU
-    # tunnel); honor an explicit JAX_PLATFORMS override so CPU/virtual-mesh
-    # CLI runs work the way the env var promises (no-op when unset or when
-    # it matches the pinned default)
-    p = os.environ.get("JAX_PLATFORMS")
-    if p:
-        jax.config.update("jax_platforms", p)
+    from tpu_radix_join.utils.platform import apply_platform_override
+
+    apply_platform_override()
 
     from tpu_radix_join import HashJoin, JoinConfig, Relation
     from tpu_radix_join.parallel.multihost import initialize as init_multihost

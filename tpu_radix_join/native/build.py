@@ -55,7 +55,6 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     """Declare signatures; raises AttributeError on missing symbols."""
     u64, u32, i32 = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int
     p_u32 = ctypes.POINTER(ctypes.c_uint32)
-    p_f64 = ctypes.POINTER(ctypes.c_double)
     lib.pool_create.restype = ctypes.c_void_p
     lib.pool_create.argtypes = [ctypes.c_size_t]
     lib.pool_get_memory.restype = ctypes.c_void_p
@@ -68,8 +67,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.pool_destroy.argtypes = [ctypes.c_void_p]
     lib.fill_unique.argtypes = [p_u32, u64, u64, u64, u32, p_u32, i32]
     lib.fill_modulo.argtypes = [p_u32, u64, u64, u32, i32]
-    lib.fill_zipf.argtypes = [p_u32, u64, u64, p_f64, u64, u64,
-                              ctypes.c_double, u64, i32]
+    lib.fill_zipf.argtypes = [p_u32, u64, u64, p_u32, u64, p_u32, u64,
+                              u64, i32]
     lib.fill_rids.argtypes = [p_u32, u64, u64, i32]
     return lib
 

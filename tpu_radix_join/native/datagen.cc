@@ -99,50 +99,55 @@ void fill_modulo(std::uint32_t* keys_out, std::uint64_t start,
   });
 }
 
-// Zipf(theta) draw over [0, domain) via inverse-CDF on a caller-provided
-// rank table (the Python layer builds it so native and numpy paths share the
-// exact float64 table and produce bit-identical keys).  splitmix64 seeded by
-// the *global* tuple index keeps shards/threads independent and the stream
-// deterministic in (seed, index).
-static inline std::uint64_t splitmix64(std::uint64_t x) {
-  x += 0x9E3779B97f4A7C15ull;
-  x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ull;
-  x = (x ^ (x >> 27)) * 0x94D049BB133111EBull;
-  return x ^ (x >> 31);
+// Zipf draw over [0, domain) from the integer-scaled tables the Python
+// layer builds (data/relation.py zipf_tables): head ranks by upper-bound
+// search of the 2^32-scaled uint32 CDF, tail ranks by linear interpolation
+// of the 4097-entry inverse-CDF key table.  Every operation below is uint32
+// arithmetic mirrored EXACTLY by zipf_keys_np (numpy) and _zipf_range
+// (device), so all three samplers are bit-identical — including on TPU,
+// which has no float64 (the f64 runs once, host-side, at table build).
+// mix32 must match utils/hashing.py.
+static inline std::uint32_t mix32(std::uint32_t x) {
+  x ^= x >> 16;
+  x *= 0x7FEB352Du;
+  x ^= x >> 15;
+  x *= 0x846CA68Bu;
+  return x ^ (x >> 16);
 }
 
 void fill_zipf(std::uint32_t* keys_out, std::uint64_t start,
-               std::uint64_t count, const double* cdf,
-               std::uint64_t table_size, std::uint64_t domain, double theta,
-               std::uint64_t seed, int num_threads) {
-  const double head = cdf[table_size - 1];
-  // Ranks past the table follow the continuous power-law tail:
-  // integral of x^-(1+theta) over [table_size, domain].
-  const double t_pow = std::pow(static_cast<double>(table_size), -theta);
-  const double d_pow = std::pow(static_cast<double>(domain), -theta);
-  const double tail = domain > table_size ? (t_pow - d_pow) / theta : 0.0;
-  const double total = head + tail;
+               std::uint64_t count, const std::uint32_t* head_cdf,
+               std::uint64_t table_size, const std::uint32_t* tail_keys,
+               std::uint64_t domain, std::uint64_t seed, int num_threads) {
+  const std::uint32_t seed_mix =
+      mix32(static_cast<std::uint32_t>(seed & 0xFFFFFFFFull));
+  const std::uint32_t head_end = head_cdf[table_size - 1];
+  const std::uint32_t dom_max = static_cast<std::uint32_t>(domain - 1);
+  const bool has_tail = domain > table_size;
   run_threads(count, num_threads, [&](std::uint64_t lo, std::uint64_t hi) {
     for (std::uint64_t i = lo; i < hi; ++i) {
-      double u =
-          (splitmix64(seed ^ (start + i)) >> 11) * (1.0 / 9007199254740992.0);
-      double target = u * total;
-      if (target > head) {
-        // inverse-CDF of the continuous tail
-        double frac = (target - head) / tail;
-        double x = std::pow(t_pow - frac * (t_pow - d_pow), -1.0 / theta);
-        std::uint64_t k = static_cast<std::uint64_t>(x);
-        if (k < table_size) k = table_size;
-        if (k >= domain) k = domain - 1;
-        keys_out[i] = static_cast<std::uint32_t>(k);
+      const std::uint32_t u =
+          mix32(static_cast<std::uint32_t>(start + i) ^ seed_mix);
+      if (has_tail && u >= head_end) {
+        // tail: second mixed draw supplies (segment, fraction) bits
+        const std::uint32_t v = mix32(u ^ 0x9E3779B9u);
+        const std::uint32_t j = v >> 20;
+        const std::uint32_t frac = (v >> 8) & 0xFFFu;
+        const std::uint32_t tk = tail_keys[j];
+        const std::uint32_t d = tail_keys[j + 1] - tk;
+        const std::uint32_t interp =
+            (d >> 12) * frac + (((d & 0xFFFu) * frac) >> 12);
+        const std::uint32_t s = tk + interp;   // may wrap near 2^32
+        keys_out[i] = (s < tk) ? dom_max : (s < dom_max ? s : dom_max);
         continue;
       }
-      // lower_bound: first rank with cdf >= target (== np.searchsorted left)
-      std::uint64_t a = 0, b = table_size - 1;
+      // upper_bound: #{k : head_cdf[k] <= u} (== np.searchsorted right)
+      std::uint64_t a = 0, b = table_size;
       while (a < b) {
         std::uint64_t m = (a + b) / 2;
-        if (cdf[m] < target) a = m + 1; else b = m;
+        if (head_cdf[m] <= u) a = m + 1; else b = m;
       }
+      if (a >= table_size) a = table_size - 1;
       keys_out[i] = static_cast<std::uint32_t>(a);
     }
   });

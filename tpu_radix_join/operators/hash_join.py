@@ -44,6 +44,9 @@ from tpu_radix_join.histograms import (
     compute_partition_assignment,
 )
 from tpu_radix_join.ops.build_probe import (
+    DENSE_BUCKET_LIMIT,
+    bucket_rows_count,
+    bucket_rows_sort,
     probe_count_bucketized,
     probe_count_chunked,
     probe_materialize,
@@ -210,16 +213,20 @@ class HashJoin:
         return (cls._concat_hot(batch, hot_batch),
                 jnp.concatenate([valid, hot_valid]))
 
-    @staticmethod
-    def _rollback_attempt(m, dts) -> None:
+    # phase keys nested inside another recorded phase (SNETCOMPL in JMPI;
+    # BPBUILD/BPPROBE in JPROC): rolled back from their own columns on a
+    # superseded attempt but not double-added to MWINWAIT
+    _NESTED_PHASES = frozenset({"SNETCOMPL", "BPBUILD", "BPPROBE"})
+
+    @classmethod
+    def _rollback_attempt(cls, m, dts) -> None:
         """Reclassify a superseded attempt's phase times into MWINWAIT (the
         reference's stall column, Measurements.cpp:272-349) so the phase
-        columns report only the attempt that produced the result.  SNETCOMPL
-        is nested inside JMPI: rolled back from its own key but not
-        double-added to MWINWAIT."""
+        columns report only the attempt that produced the result."""
         m.incr("RETRIES")
         m.add_time_us("MWINWAIT",
-                      sum(v for k, v in dts.items() if k != "SNETCOMPL"))
+                      sum(v for k, v in dts.items()
+                          if k not in cls._NESTED_PHASES))
         for k, v in dts.items():
             if v:
                 m.times_us[k] -= v
@@ -342,12 +349,24 @@ class HashJoin:
                 else:
                     counts, maxw = merge_count_per_partition(
                         r.key, s.key, fanout, return_max_weight=True)
-                # overflow-risk bound: no shuffle histograms exist on this
-                # path, so one histogram pass over the outer pids buys the
-                # per-partition outer counts the bound needs
-                s_pid = s.key & jnp.uint32(num_p - 1)
-                count_risk = self._count_risk(
-                    maxw, local_histogram(s_pid, num_p))
+                # overflow-risk bound: the scalar pre-test
+                # maxw * |S| < 2**32 clears every realistic workload with
+                # zero extra passes; only suspect workloads pay the
+                # per-partition histogram refinement under the cond (no
+                # shuffle histograms exist on this no-shuffle path)
+                scalar_limit = (2**32 - 1) // max(1, s.key.shape[0])
+
+                def _refine(mw):
+                    s_pid = s.key & jnp.uint32(num_p - 1)
+                    return self._count_risk(mw,
+                                            local_histogram(s_pid, num_p))
+
+                count_risk = jax.lax.cond(
+                    maxw > jnp.uint32(scalar_limit),
+                    _refine,
+                    # same varying annotation as the refine branch
+                    lambda mw: mw > jnp.uint32(0xFFFFFFFF),
+                    maxw)
                 zero = jnp.uint32(0)
                 flags = jnp.stack([
                     jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
@@ -409,7 +428,7 @@ class HashJoin:
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = self._keys_in_contract(r, s, materialize=materialize)
             (rp, sp, hot_batch, lost_r, lost_s, hot_overflow, conserve_bad,
-             _s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
+             s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
             sflags = jnp.stack([
                 jax.lax.psum((~keys_ok).astype(jnp.uint32), ax),
                 lost_r.astype(jnp.uint32),
@@ -426,6 +445,11 @@ class HashJoin:
                 out = (rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, sflags)
             if skew_plan:
                 out = out + (hot_batch,)
+            if not materialize:
+                # the probe program's overflow-risk bound reads the global
+                # outer histogram — ship the tiny [P] array instead of
+                # re-histogramming the receive buffers there
+                out = out + (s_gh,)
             return out
 
         spec = P(ax)
@@ -439,6 +463,8 @@ class HashJoin:
             out_specs = (spec, spec, spec, spec, spec, P())
         if skew_plan:
             out_specs = out_specs + (spec,)
+        if not materialize:
+            out_specs = out_specs + (P(),)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=out_specs))
@@ -450,23 +476,24 @@ class HashJoin:
         cfg = self.config
         ax = cfg.mesh_axes
 
-        def run(rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch):
+        def run(rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
+                s_gh):
             counts, local_overflow, count_risk = self._local_process(
                 rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
-                cap_r, cap_s, local_slack)
+                cap_r, cap_s, local_slack, s_hist_bound=s_gh)
             return (counts,
                     jax.lax.psum(local_overflow.astype(jnp.uint32), ax),
                     jax.lax.psum(count_risk.astype(jnp.uint32), ax))
 
         spec = P(ax)
         if skew_plan:
-            def body(rpb, rpv, spb, spv, spp, hot):
-                return run(rpb, rpv, spb, spv, spp, hot)
-            in_specs = (spec, spec, spec, spec, spec, spec)
+            def body(rpb, rpv, spb, spv, spp, hot, s_gh):
+                return run(rpb, rpv, spb, spv, spp, hot, s_gh)
+            in_specs = (spec, spec, spec, spec, spec, spec, P())
         else:
-            def body(rpb, rpv, spb, spv, spp):
-                return run(rpb, rpv, spb, spv, spp, None)
-            in_specs = (spec, spec, spec, spec, spec)
+            def body(rpb, rpv, spb, spv, spp, s_gh):
+                return run(rpb, rpv, spb, spv, spp, None, s_gh)
+            in_specs = (spec, spec, spec, spec, spec, P())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
             out_specs=(spec, P(), P())))
@@ -536,15 +563,62 @@ class HashJoin:
             if m:
                 dts["SLOCPREP"] = m.stop("SLOCPREP",
                                          fence=(lr_blocks, ls_blocks))
-            fn_bp = self._compile_timed(
-                ("bprobe", local_slack) + base,
-                lambda: self._bp_fn(cap_r, cap_s, local_slack, skew_plan
-                                    ).lower(lr_blocks, ls_blocks).compile())
+            lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                               skew_plan)
+            wide = r.key_hi is not None
             if m:
-                m.start("JPROC")
-            counts, count_risk = fn_bp(lr_blocks, ls_blocks)
-            if m:
-                dts["JPROC"] = m.stop("JPROC", fence=counts)
+                # capacity-padded slots the build/probe stages process (the
+                # reference's per-task tuple sums, BPBUILDTUPLES/
+                # BPPROBETUPLES, Measurements.cpp:471-542); retried attempts
+                # count too — those slots were processed
+                nb = cfg.local_partition_count
+                n = cfg.num_nodes
+                m.incr("BPBUILDTUPLES", n * nb * lcap_r)
+                m.incr("BPPROBETUPLES", n * nb * lcap_s)
+            if max(lcap_r, lcap_s) <= DENSE_BUCKET_LIMIT:
+                # dense equality-reduction discipline: no build structure
+                # exists (the GPU shared-memory probe analog), so the whole
+                # program is the probe stage
+                fn_bp = self._compile_timed(
+                    ("bprobe", local_slack) + base,
+                    lambda: self._bp_fn(cap_r, cap_s, local_slack, skew_plan
+                                        ).lower(lr_blocks,
+                                                ls_blocks).compile())
+                if m:
+                    m.start("JPROC")
+                counts, count_risk = fn_bp(lr_blocks, ls_blocks)
+                if m:
+                    dts["JPROC"] = m.stop("JPROC", fence=counts)
+                    m.add_time_us("BPPROBE", dts["JPROC"])
+                    dts["BPPROBE"] = dts["JPROC"]
+            else:
+                # merge discipline: the batched row sort is the build stage
+                # (BPBUILD) and the weight scan the probe stage (BPPROBE),
+                # each its own program so the host clock times them — the
+                # reference's build/probe sub-columns (Measurements.cpp:
+                # 471-542); JPROC spans both, as its BuildProbe task does
+                fn_bb = self._compile_timed(
+                    ("bpbuild", local_slack) + base,
+                    lambda: self._bp_build_fn(
+                        cap_r, cap_s, local_slack, skew_plan, wide
+                    ).lower(lr_blocks, ls_blocks).compile())
+                if m:
+                    m.start("JPROC")
+                    m.start("BPBUILD")
+                sorted_lanes = fn_bb(lr_blocks, ls_blocks)
+                if m:
+                    dts["BPBUILD"] = m.stop("BPBUILD", fence=sorted_lanes)
+                fn_bp2 = self._compile_timed(
+                    ("bpprobe", local_slack) + base,
+                    lambda: self._bp_probe_fn(
+                        cap_r, cap_s, local_slack, skew_plan, wide
+                    ).lower(*sorted_lanes).compile())
+                if m:
+                    m.start("BPPROBE")
+                counts, count_risk = fn_bp2(*sorted_lanes)
+                if m:
+                    dts["BPPROBE"] = m.stop("BPPROBE", fence=counts)
+                    dts["JPROC"] = m.stop("JPROC", fence=counts)
         else:
             probe_args = tuple(shuffled[:5]) + tuple(shuffled[6:])
             fn_proc = self._compile_timed(
@@ -631,29 +705,33 @@ class HashJoin:
         return (cfg.bucket_capacity(n * cap_r + hot_total, nb) * local_slack,
                 cfg.bucket_capacity(n * cap_s, nb) * local_slack)
 
+    @staticmethod
+    def _guarded_bucket_counts(count_fn, lcap_r: int, lcap_s: int):
+        """(counts, count-overflow risk) for a bucketized counting callable
+        ``count_fn(return_max_weight=...)``: a bucket's count is statically
+        <= lcap_r * lcap_s, so the runtime max-weight bound
+        (:meth:`_count_risk` rationale) only runs when that product can
+        reach 2**32 — ONE definition shared by the fused probe and the
+        phase-split BPPROBE program so the two cannot diverge."""
+        if lcap_r * lcap_s < (1 << 32):
+            counts = count_fn(return_max_weight=False)
+            # statically-safe False that still carries the counts' device-
+            # varying annotation (a bare constant would trip shard_map's
+            # psum varying check at the flag-assembly site)
+            return counts, jnp.sum(counts) < jnp.uint32(0)
+        counts, maxw = count_fn(return_max_weight=True)
+        return counts, maxw > jnp.uint32(0xFFFFFFFF // lcap_s)
+
     def _bucket_probe(self, lr_blocks: TupleBatch, ls_blocks: TupleBatch,
                       lcap_r: int, lcap_s: int):
         """Per-bucket counting over capacity-padded bucket blocks; wide keys'
         hi lanes ride the same blocks and the probe's three-key batched row
         sort compares full (hi, lo) pairs.  Returns (counts, count-overflow
-        risk): a bucket's count is statically <= lcap_r * lcap_s, so the
-        runtime max-weight bound (:meth:`_count_risk` rationale) only runs
-        when that product can reach 2**32."""
-        nb = self.config.local_partition_count
-        args = (lr_blocks.key.reshape(nb, lcap_r),
-                ls_blocks.key.reshape(nb, lcap_s),
-                None if lr_blocks.key_hi is None
-                else lr_blocks.key_hi.reshape(nb, lcap_r),
-                None if ls_blocks.key_hi is None
-                else ls_blocks.key_hi.reshape(nb, lcap_s))
-        if lcap_r * lcap_s < (1 << 32):
-            counts = probe_count_bucketized(*args)
-            # statically-safe False that still carries the counts' device-
-            # varying annotation (a bare constant would trip shard_map's
-            # psum varying check at the flag-assembly site)
-            return counts, jnp.sum(counts) < jnp.uint32(0)
-        counts, maxw = probe_count_bucketized(*args, return_max_weight=True)
-        return counts, maxw > jnp.uint32(0xFFFFFFFF // lcap_s)
+        risk)."""
+        args = self._bucket_row_args(lr_blocks, ls_blocks, lcap_r, lcap_s)
+        return self._guarded_bucket_counts(
+            functools.partial(probe_count_bucketized, *args),
+            lcap_r, lcap_s)
 
     def _lp_fn(self, cap_r: int, cap_s: int, local_slack: int,
                skew_plan=None):
@@ -711,6 +789,58 @@ class HashJoin:
             body, mesh=self.mesh, in_specs=(spec, spec),
             out_specs=(spec, P())))
 
+    def _bucket_row_args(self, lr_blocks: TupleBatch, ls_blocks: TupleBatch,
+                         lcap_r: int, lcap_s: int):
+        nb = self.config.local_partition_count
+        return (lr_blocks.key.reshape(nb, lcap_r),
+                ls_blocks.key.reshape(nb, lcap_s),
+                None if lr_blocks.key_hi is None
+                else lr_blocks.key_hi.reshape(nb, lcap_r),
+                None if ls_blocks.key_hi is None
+                else ls_blocks.key_hi.reshape(nb, lcap_s))
+
+    def _bp_build_fn(self, cap_r: int, cap_s: int, local_slack: int,
+                     skew_plan, wide: bool):
+        """BPBUILD program: the batched per-bucket row sort as its own
+        program so the host clock times the build stage separately — the
+        reference's hash-table-build column (BPBUILD + tuple sums,
+        Measurements.cpp:471-505).  The sorted-row layout is this
+        framework's hash table (see ops/build_probe.bucket_rows_sort)."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                           skew_plan)
+
+        def body(lr_blocks, ls_blocks):
+            return bucket_rows_sort(*self._bucket_row_args(
+                lr_blocks, ls_blocks, lcap_r, lcap_s))
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec),
+            out_specs=(spec,) * (3 if wide else 2)))
+
+    def _bp_probe_fn(self, cap_r: int, cap_s: int, local_slack: int,
+                     skew_plan, wide: bool):
+        """BPPROBE program: the weight scan over pre-sorted bucket rows —
+        the reference's probe-loop column (BPPROBE, Measurements.cpp:
+        506-542) — with the same uint32-overflow guard as the fused path."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        lcap_r, lcap_s = self._bucket_caps(cap_r, cap_s, local_slack,
+                                           skew_plan)
+
+        def body(*lanes):
+            counts, risk = self._guarded_bucket_counts(
+                functools.partial(bucket_rows_count, *lanes),
+                lcap_r, lcap_s)
+            return counts, jax.lax.psum(risk.astype(jnp.uint32), ax)
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec,) * (3 if wide else 2),
+            out_specs=(spec, P())))
+
     @staticmethod
     def _count_risk(max_weight, s_hist) -> jnp.ndarray:
         """True when some partition's uint32 match count could have wrapped.
@@ -737,9 +867,11 @@ class HashJoin:
         (per-partition counts, local overflow, count-overflow risk).
 
         ``s_hist_bound``: global per-partition outer tuple counts for the
-        overflow-risk bound; the fused pipeline passes the shuffle's s_ghist
-        (free), the split probe program passes None and one histogram pass
-        recomputes it from the received pid lane."""
+        overflow-risk bound — always the shuffle's s_ghist (free: the fused
+        pipeline has it in scope; the split probe program receives the tiny
+        [P] array as an input).  Required on the non-bucket paths; the
+        bucket path bounds per-bucket counts from static capacities
+        instead."""
         cfg = self.config
         ax = cfg.mesh_axes
         fanout = cfg.network_fanout_bits
@@ -763,8 +895,9 @@ class HashJoin:
                 lr.blocks, ls.blocks, lcap_r, lcap_s)
             return counts, lr.overflow + ls.overflow, count_risk
         if s_hist_bound is None:
-            s_hist_bound = jax.lax.psum(
-                local_histogram(sp_pid, num_p, valid=sp_valid), ax)
+            raise ValueError(
+                "non-bucket local processing requires s_hist_bound (the "
+                "shuffle's global outer histogram) for the overflow guard")
         if cfg.chunk_size:
             # out-of-core discipline (LD kernels): outer slabs under scan
             counts, maxw = probe_count_chunked(
@@ -1219,9 +1352,11 @@ class HashJoin:
                 # inside the first join's fence would inflate its phase times
                 return jax.block_until_ready(batch)
             if cfg.generation == "device":
+                # unreachable for today's kinds (unique/modulo/zipf all
+                # generate on device since r4); kept for future kinds
                 raise ValueError(
                     f"generation='device' but relation kind {rel.kind!r} "
-                    f"has no on-device generator (host-only f64 tables)")
+                    f"has no on-device generator")
         sharding = NamedSharding(self.mesh, P(cfg.mesh_axes))
         shards = [rel.shard_np(i) for i in range(n)]
         wide = rel.key_bits == 64   # authoritative; shard_np must agree

@@ -277,38 +277,45 @@ def probe_count_bucketized(
                                         return_max_weight=return_max_weight)
 
 
-def probe_count_bucketized_merge(
+def bucket_rows_sort(
     inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
     inner_hi: jnp.ndarray | None = None,
     outer_hi: jnp.ndarray | None = None,
-    return_max_weight: bool = False,
 ):
-    """Batched per-bucket sort-merge counting (same contract as
-    :func:`probe_count_bucketized`).
-
-    Each bucket row is sorted lexicographically by (key, side-tag) — or
-    (hi, lo, side-tag) for wide keys, the three-key batched row sort — in
-    one batched ``lax.sort`` over axis 1, then the merge-count weight scan
-    (cumsum/cummax of ops/merge_count) runs along the rows.  R/S pad
-    sentinels differ (tuples.py), so padding forms its own runs and
-    contributes zero.
-    """
-    from tpu_radix_join.ops.merge_count import _run_weights
-    nb = inner_blocks.shape[0]
+    """BUILD stage of the bucketized merge probe: one batched lexicographic
+    row sort of the concatenated (inner | outer) bucket rows — (key, tag) or
+    (hi, lo, tag) for wide keys.  The sorted-row layout is this framework's
+    "hash table": the structure the probe scan walks, making the stage the
+    honest analog of the reference's per-task hash-table build (BPBUILD,
+    tasks/BuildProbe.cpp:47-77 / Measurements.cpp:471-505).  Returns the
+    sorted lanes ``(keys, tag)`` or ``(his, keys, tag)`` for
+    :func:`bucket_rows_count`."""
     keys = jnp.concatenate([inner_blocks, outer_blocks], axis=1)
     tag = jnp.concatenate([
         jnp.zeros(inner_blocks.shape, jnp.uint32),
         jnp.ones(outer_blocks.shape, jnp.uint32)], axis=1)
-    fill = jnp.full((nb, 1), 0xFFFFFFFF, jnp.uint32)
     if inner_hi is not None:
         his = jnp.concatenate([inner_hi, outer_hi], axis=1)
-        his, keys, tag = sort_lex_unstable(his, keys, tag, num_keys=3,
-                                           dimension=1)
+        return sort_lex_unstable(his, keys, tag, num_keys=3, dimension=1)
+    return sort_lex_unstable(keys, tag, num_keys=2, dimension=1)
+
+
+def bucket_rows_count(*sorted_lanes, return_max_weight: bool = False):
+    """PROBE stage of the bucketized merge probe: the merge-count weight
+    scan (cumsum/cummax of ops/merge_count) along pre-sorted bucket rows
+    from :func:`bucket_rows_sort` — the analog of the reference's per-task
+    probe loop (BPPROBE, tasks/BuildProbe.cpp:79-121 /
+    Measurements.cpp:506-542).  R/S pad sentinels differ (tuples.py), so
+    padding forms its own runs and contributes zero."""
+    from tpu_radix_join.ops.merge_count import _run_weights
+    fill = jnp.full((sorted_lanes[0].shape[0], 1), 0xFFFFFFFF, jnp.uint32)
+    if len(sorted_lanes) == 3:
+        his, keys, tag = sorted_lanes
         prev_hi = jnp.concatenate([fill, his[:, :-1]], axis=1)
         prev_lo = jnp.concatenate([fill, keys[:, :-1]], axis=1)
         run_start = (his != prev_hi) | (keys != prev_lo)
     else:
-        keys, tag = sort_lex_unstable(keys, tag, num_keys=2, dimension=1)
+        keys, tag = sorted_lanes
         run_start = keys != jnp.concatenate([fill, keys[:, :-1]], axis=1)
     # vmap the 1-D weight scan over bucket rows (cumsum/cummax are along the
     # row, independent per bucket)
@@ -317,6 +324,24 @@ def probe_count_bucketized_merge(
     if return_max_weight:
         return counts, jnp.max(weights)
     return counts
+
+
+def probe_count_bucketized_merge(
+    inner_blocks: jnp.ndarray, outer_blocks: jnp.ndarray,
+    inner_hi: jnp.ndarray | None = None,
+    outer_hi: jnp.ndarray | None = None,
+    return_max_weight: bool = False,
+):
+    """Batched per-bucket sort-merge counting (same contract as
+    :func:`probe_count_bucketized`): :func:`bucket_rows_sort` (the build
+    stage) + :func:`bucket_rows_count` (the probe scan) fused in one
+    program — the phase-split driver runs the two stages as separate
+    programs to time BPBUILD/BPPROBE from the host clock.
+    """
+    sorted_lanes = bucket_rows_sort(inner_blocks, outer_blocks,
+                                    inner_hi, outer_hi)
+    return bucket_rows_count(*sorted_lanes,
+                             return_max_weight=return_max_weight)
 
 
 class MaterializedMatches(NamedTuple):

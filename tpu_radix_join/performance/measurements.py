@@ -191,12 +191,41 @@ class Measurements:
         self.meta["memory"] = out
         return out
 
-    def trace(self, trace_dir: str):
+    def trace(self, trace_dir: str, record: bool = True):
         """Profiler context (PAPI/CUDA-event analog, Measurements.cpp:90-107 /
-        eth.cu:179-222): wraps ``jax.profiler.trace`` so the jit-internal
-        phase split (histogram/shuffle/probe) is observable even though host
-        timers only see whole programs."""
-        return jax.profiler.trace(trace_dir)
+        eth.cu:179-222): wraps ``jax.profiler.trace`` AND, on exit, parses
+        the written xplane artifact (performance/trace.py) so the
+        jit-internal phase split (histogram/shuffle/probe/sort) becomes
+        registry data, not just a TensorBoard file:
+
+          * ``CTOTAL`` (times_us) — device busy time, the analog of the
+            reference's PAPI total-cycles bracket (CTOTAL,
+            Measurements.cpp:90-107);
+          * ``meta["trace"]`` — the busiest-timeline per-op breakdown
+            ({op: {us, count}}, heaviest first).
+
+        ``record=False`` restores the bare passthrough."""
+        if not record:
+            return jax.profiler.trace(trace_dir)
+
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            with jax.profiler.trace(trace_dir):
+                yield self
+            from tpu_radix_join.performance.trace import (
+                _is_device_plane, summarize_trace)
+            summary = summarize_trace(trace_dir)
+            if summary is not None:
+                self.meta["trace"] = summary
+                # CTOTAL only from a real device timeline: a host plane's
+                # busiest line sums nested Python frames, which is not a
+                # cycles-analog (CPU-backend traces have no device plane)
+                if _is_device_plane(summary["plane"]):
+                    self.times_us["CTOTAL"] = summary["busy_us"]
+
+        return _ctx()
 
     # ---------------------------------------------------------------- output
     def lines(self):

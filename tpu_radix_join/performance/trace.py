@@ -1,0 +1,199 @@
+"""Profiler-trace parsing: per-op device time from ``jax.profiler`` traces.
+
+The hardware-counter analog the reference gets from PAPI (total cycles
+bracketing the join, ``performance/Measurements.cpp:90-107`` -> ``CTOTAL``)
+and from CUDA events around each kernel (``operators/gpu/eth.cu:179-222``).
+A TPU program is one XLA binary, so the equivalent visibility comes from the
+profiler's trace: per-op rows on the device timeline.  This module turns the
+``*.xplane.pb`` artifacts ``jax.profiler.trace`` writes into:
+
+  * ``CTOTAL`` — device busy time (the busiest device timeline's summed event
+    durations), the cycles-analog recorded into ``.perf`` via
+    :meth:`Measurements.trace`;
+  * a per-op breakdown ({op name: total time, count}) — the evidence for
+    claims like "the fused 16M pipeline is >= 95% sort" (VERDICT r3 weak #2's
+    last unverified link).
+
+The xplane file is a protobuf (tensorflow/tsl XSpace), but importing
+tensorflow for five field numbers is a heavy, fragile dependency — this is a
+minimal wire-format decoder instead, hardcoding the XSpace schema:
+
+  XSpace.planes = 1;  XPlane{ name = 2, lines = 3, event_metadata = 4 }
+  XLine{ name = 2, display_name = 11, events = 4 }
+  XEvent{ metadata_id = 1, duration_ps = 3, num_occurrences = 5 }
+  XEventMetadata map entry{ key = 1, value = 2 };  XEventMetadata{ id = 1,
+  name = 2, display_name = 4 }
+
+(field numbers verified against tensorflow.tsl.profiler.protobuf.xplane_pb2
+in this image; the schema is append-only so unknown fields are skipped by
+wire type, which is exactly what protobuf guarantees is safe).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from typing import Dict, Iterator, List, Optional, Tuple
+
+
+def _iter_fields(buf: memoryview) -> Iterator[Tuple[int, int, object]]:
+    """Yield (field_number, wire_type, value) over one message's bytes.
+    Varints -> int; length-delimited -> memoryview; 32/64-bit -> raw bytes."""
+    i, n = 0, len(buf)
+    while i < n:
+        tag = 0
+        shift = 0
+        while True:
+            b = buf[i]
+            i += 1
+            tag |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:           # varint
+            v = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                v |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, v
+        elif wire == 2:         # length-delimited
+            ln = 0
+            shift = 0
+            while True:
+                b = buf[i]
+                i += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            yield field, wire, buf[i:i + ln]
+            i += ln
+        elif wire == 1:         # 64-bit
+            yield field, wire, bytes(buf[i:i + 8])
+            i += 8
+        elif wire == 5:         # 32-bit
+            yield field, wire, bytes(buf[i:i + 4])
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+
+
+def _parse_line(buf: memoryview) -> Tuple[str, Dict[int, List[int]]]:
+    """One XLine -> (name, {metadata_id: [total_ps, occurrences]})."""
+    name = ""
+    display = ""
+    per_md: Dict[int, List[int]] = {}
+    for field, wire, val in _iter_fields(buf):
+        if field == 2 and wire == 2:
+            name = bytes(val).decode(errors="replace")
+        elif field == 11 and wire == 2:
+            display = bytes(val).decode(errors="replace")
+        elif field == 4 and wire == 2:    # XEvent
+            md, dur, occ = 0, 0, 1
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 0:
+                    md = v2
+                elif f2 == 3 and w2 == 0:
+                    dur = v2
+                elif f2 == 5 and w2 == 0:
+                    occ = max(1, v2)
+            acc = per_md.setdefault(md, [0, 0])
+            acc[0] += dur
+            acc[1] += occ
+    return display or name, per_md
+
+
+def _parse_plane(buf: memoryview) -> dict:
+    """One XPlane -> {"name", "lines": [(line_name, {md: [ps, n]})],
+    "metadata": {id: name}}."""
+    name = ""
+    lines = []
+    metadata: Dict[int, str] = {}
+    for field, wire, val in _iter_fields(buf):
+        if field == 2 and wire == 2:
+            name = bytes(val).decode(errors="replace")
+        elif field == 3 and wire == 2:
+            lines.append(_parse_line(val))
+        elif field == 4 and wire == 2:    # map<int64, XEventMetadata> entry
+            md_id, md_name, md_disp = 0, "", ""
+            for f2, w2, v2 in _iter_fields(val):
+                if f2 == 1 and w2 == 0:
+                    md_id = v2
+                elif f2 == 2 and w2 == 2:   # XEventMetadata
+                    for f3, w3, v3 in _iter_fields(v2):
+                        if f3 == 1 and w3 == 0:
+                            md_id = v3
+                        elif f3 == 2 and w3 == 2:
+                            md_name = bytes(v3).decode(errors="replace")
+                        elif f3 == 4 and w3 == 2:
+                            md_disp = bytes(v3).decode(errors="replace")
+            metadata[md_id] = md_disp or md_name
+    return {"name": name, "lines": lines, "metadata": metadata}
+
+
+def parse_xspace(data: bytes) -> List[dict]:
+    """All XPlanes of one serialized XSpace."""
+    return [_parse_plane(val)
+            for field, wire, val in _iter_fields(memoryview(data))
+            if field == 1 and wire == 2]
+
+
+def find_xplane_files(trace_dir: str) -> List[str]:
+    return sorted(glob.glob(
+        os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
+
+
+def _is_device_plane(name: str) -> bool:
+    n = name.lower()
+    return n.startswith("/device:") or "tpu" in n or "gpu" in n
+
+
+def summarize_trace(trace_dir: str) -> Optional[dict]:
+    """Aggregate the trace directory into the device-op breakdown.
+
+    Returns {"plane": name, "busy_us": float, "ops": {op: {"us", "count"}}}
+    for the busiest device plane (falling back to the busiest plane of any
+    kind — CPU-backend traces put XLA ops on host planes), or None when the
+    directory holds no parsable xplane artifact."""
+    best = None
+    for path in find_xplane_files(trace_dir):
+        with open(path, "rb") as f:
+            planes = parse_xspace(f.read())
+        for plane in planes:
+            # busiest line = the execution timeline; other lines (launch,
+            # framework annotations) overlap it
+            busy = 0
+            busy_line = None
+            for line_name, per_md in plane["lines"]:
+                tot = sum(ps for ps, _ in per_md.values())
+                if tot > busy:
+                    busy, busy_line = tot, per_md
+            if busy_line is None:
+                continue
+            entry = {
+                "plane": plane["name"],
+                "busy_us": busy / 1e6,
+                "ops": {
+                    plane["metadata"].get(md, f"op_{md}"):
+                        {"us": ps / 1e6, "count": n}
+                    for md, (ps, n) in sorted(
+                        busy_line.items(), key=lambda kv: -kv[1][0])
+                },
+            }
+            rank = (1 if _is_device_plane(plane["name"]) else 0, busy)
+            if best is None or rank > best[0]:
+                best = (rank, entry)
+    return best[1] if best else None
+
+
+def top_ops(summary: dict, k: int = 12) -> List[Tuple[str, float, int]]:
+    """[(op, total_us, count)] for the k heaviest ops of a summary."""
+    items = [(name, v["us"], v["count"]) for name, v in summary["ops"].items()]
+    items.sort(key=lambda t: -t[1])
+    return items[:k]

@@ -21,6 +21,22 @@ import re
 _FLAG = "--xla_force_host_platform_device_count"
 
 
+def apply_platform_override() -> None:
+    """Honor an explicit ``JAX_PLATFORMS`` env override after import.
+
+    The container's sitecustomize imports jax at interpreter start pinned to
+    the live-TPU tunnel, locking the config *default* — the env var alone is
+    silently ignored afterwards (module docstring hazard 1).  Entry points
+    (CLI, experiments) call this once right after ``import jax`` so
+    ``JAX_PLATFORMS=cpu python ...`` behaves the way the env var promises;
+    a no-op when unset or when it matches the pinned default."""
+    p = os.environ.get("JAX_PLATFORMS")
+    if p:
+        import jax
+
+        jax.config.update("jax_platforms", p)
+
+
 def force_host_cpu_devices(n: int, respect_existing: bool = False,
                            defer_check: bool = False) -> None:
     """Make ``jax.devices()`` return at least ``n`` virtual CPU devices.
