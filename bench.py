@@ -20,7 +20,11 @@ vs_baseline >= 1.0 therefore means beating reference-class per-accelerator
 throughput.
 """
 
+import glob
 import json
+import math
+import os
+import re
 import sys
 import time
 
@@ -38,32 +42,92 @@ def _time_amortized(fn, args, iters=20):
     return (time.perf_counter() - t0) / iters
 
 
-def main():
-    # A downed axon tunnel makes jax.devices() block on a *native* futex that
-    # a SIGALRM Python handler can never interrupt; probe the backend in a
-    # child process with a hard timeout so the bench fails fast and loud
-    # instead of hanging the driver forever.
+def _wait_for_backend():
+    """Probe the device backend, retrying a downed tunnel for up to
+    BENCH_TUNNEL_WAIT_SEC (default 20 min) before giving up.
+
+    A downed axon tunnel makes jax.devices() block on a *native* futex that
+    a SIGALRM Python handler can never interrupt; probe in a child process
+    with a hard per-attempt timeout.  Two rounds of BENCH_r0*.json rc=2
+    showed a one-shot 120s window loses against tunnel flakiness, so the
+    bench now rides out transient outages itself instead of leaving the
+    round's official capture empty.
+    """
     import subprocess
-    try:
-        # sitecustomize locks the platform default at import, so the child
-        # re-applies any JAX_PLATFORMS override the same way the parent must
-        probe = subprocess.run(
-            [sys.executable, "-c",
-             "import os, jax\n"
-             "p = os.environ.get('JAX_PLATFORMS')\n"
-             "p and jax.config.update('jax_platforms', p)\n"
-             "print(jax.devices()[0])"],
-            capture_output=True, text=True, timeout=120)
-    except subprocess.TimeoutExpired:
-        print("ERROR: device backend did not come up within 120s — the TPU "
-              "tunnel hangs rather than failing when it is down; aborting",
-              file=sys.stderr)
-        sys.exit(2)
-    if probe.returncode != 0:
-        print(f"ERROR: device backend unavailable:\n{probe.stderr.strip()}",
-              file=sys.stderr)
-        sys.exit(2)
-    print(f"note: device: {probe.stdout.strip()}", file=sys.stderr)
+    budget = float(os.environ.get("BENCH_TUNNEL_WAIT_SEC", "1200"))
+    deadline = time.monotonic() + budget
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            # sitecustomize locks the platform default at import; the child
+            # re-applies any JAX_PLATFORMS override the same way the parent
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import os, jax\n"
+                 "p = os.environ.get('JAX_PLATFORMS')\n"
+                 "p and jax.config.update('jax_platforms', p)\n"
+                 "print(jax.devices()[0])"],
+                capture_output=True, text=True, timeout=120)
+            if probe.returncode == 0:
+                print(f"note: device: {probe.stdout.strip()} "
+                      f"(probe attempt {attempt})", file=sys.stderr)
+                return
+            err = (probe.stderr.strip().splitlines() or ["?"])[-1]
+        except subprocess.TimeoutExpired:
+            err = "probe hung 120s (tunnel down)"
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            print(f"ERROR: device backend unavailable after {attempt} probes "
+                  f"over {budget:.0f}s: {err}", file=sys.stderr)
+            sys.exit(2)
+        print(f"note: backend probe {attempt} failed ({err}); "
+              f"{remaining:.0f}s of wait budget left", file=sys.stderr)
+        time.sleep(min(60.0, max(1.0, remaining)))
+
+
+def _sort_bandwidth_gbps(probe_dt_s, size):
+    """Achieved HBM GB/s of the sort stage against the external-sort traffic
+    lower bound (PERF_NOTES "sort floor": ``1 + ceil(log2(union/V))`` passes
+    of read+write over the packed union, V = 4M VMEM-resident elements).
+
+    Prefers the trace-derived per-iter sort time from the newest committed
+    ``breakdown.json`` (exp_trace_pipeline) when one matches this workload;
+    falls back to the measured probe time (an upper bound on the sort, so a
+    lower bound on GB/s).  Returns (gbps, source_label).
+    """
+    union = 2 * size
+    vmem_elems = 4 << 20
+    passes = 1 + max(0, math.ceil(math.log2(union / vmem_elems)))
+    min_traffic_bytes = passes * 2 * union * 4       # r+w, 4 B/element
+    sort_s, src = probe_dt_s, "probe_upper_bound"
+    here = os.path.dirname(os.path.abspath(__file__))
+    from tpu_radix_join.performance.trace import _is_device_plane
+
+    def round_num(path):
+        m = re.search(r"chip_r(\d+)", path)
+        return int(m.group(1)) if m else -1
+
+    for path in sorted(glob.glob(
+            os.path.join(here, "artifacts", "chip_r*", "trace_*",
+                         "breakdown.json")), key=round_num, reverse=True):
+        try:
+            with open(path) as f:
+                bd = json.load(f)
+        except (OSError, ValueError):
+            continue
+        # host-plane artifacts (CPU smoke runs) sum nested Python frames,
+        # not device time — same refusal as measurements.py's CTOTAL guard
+        if (bd.get("size") == size and bd.get("sort_share")
+                and _is_device_plane(bd.get("plane", ""))):
+            sort_s = bd["busy_us"] * bd["sort_share"] / bd["iters"] / 1e6
+            src = os.path.relpath(path, here)
+            break
+    return min_traffic_bytes / sort_s / 1e9, src
+
+
+def main():
+    _wait_for_backend()
 
     import jax
     import jax.numpy as jnp
@@ -192,11 +256,21 @@ def main():
               f"({type(e).__name__}: {e})", file=sys.stderr)
 
     tuples_per_sec = (2 * size) / dt   # both relations processed
+    # Bandwidth utilization of the dominant stage (VERDICT r4 #4): the
+    # headline ratio now carries the number that justifies or indicts it —
+    # how close the sort runs to the chip's measured HBM envelope.
+    sort_gbps, sort_src = _sort_bandwidth_gbps(dt, size)
+    print(f"note: sort stage ≈ {sort_gbps:.1f} GB/s vs ~105 GB/s sustained "
+          f"envelope (traffic lower bound / time from {sort_src})",
+          file=sys.stderr)
     print(json.dumps({
         "metric": "single_chip_join_throughput",
         "value": round(tuples_per_sec, 1),
         "unit": "tuples/sec",
         "vs_baseline": round(tuples_per_sec / 1e9, 4),
+        "sort_gbps": round(sort_gbps, 1),
+        "hbm_envelope_gbps": 105.0,
+        "sort_gbps_source": sort_src,
     }))
 
 

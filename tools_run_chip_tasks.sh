@@ -1,24 +1,23 @@
 #!/bin/bash
-# Round-4 chip-gated task runner (VERDICT r3 weak #1: the round-3 runner ran
-# tasks strictly once in sequence, so one tunnel drop mid-sequence lost
-# everything after it).  This one:
+# Round-5 chip-gated task runner (VERDICT r4 #1: invoke at round START and
+# keep re-invoking until every .done marker exists).  Behavior:
 #   * re-probes the tunnel before every task AND between retries;
 #   * retries each task up to MAX_ATTEMPTS times;
 #   * drops a .done marker per task so a rerun of the whole script resumes
 #     at the first unfinished task (the out-of-core grids additionally
 #     resume mid-task via chunked_join_grid checkpoints).
-# Outputs under artifacts/chip_r4/.
+# Outputs under artifacts/chip_r5/.
 set -u
 cd /root/repo
 export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
-OUT=artifacts/chip_r4
+OUT=artifacts/chip_r5
 mkdir -p "$OUT"
-MAX_ATTEMPTS=4
+MAX_ATTEMPTS=6
 
 probe() { timeout 60 python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; }
 
 wait_tunnel() {
-  for i in $(seq 1 200); do
+  for i in $(seq 1 400); do
     if probe; then return 0; fi
     echo "$(date -u +%H:%M:%S) tunnel down, waiting..."
     sleep 90
@@ -51,6 +50,8 @@ run bench            2400 python bench.py
 run trace_16m        2400 python experiments/exp_trace_pipeline.py 24 "$OUT/trace_16m"
 run cli_16m_sort     2400 python -m tpu_radix_join.main --tuples-per-node $SIXTEEN \
                        --nodes 1 --repeat 3 --output-dir "$OUT/perf_16m_sort"
+run cli_16m_trace    2400 python -m tpu_radix_join.main --tuples-per-node $SIXTEEN \
+                       --nodes 1 --repeat 3 --trace --output-dir "$OUT/perf_16m_trace"
 run cli_16m_phases   2400 python -m tpu_radix_join.main --tuples-per-node $SIXTEEN \
                        --nodes 1 --two-level --measure-phases --repeat 3 \
                        --output-dir "$OUT/perf_16m_phases"
@@ -63,6 +64,7 @@ run cli_zipf_device  2400 python -m tpu_radix_join.main --tuples-per-node $SIXTE
                        --nodes 1 --outer-kind zipf --zipf-theta 0.75 \
                        --generation device --repeat 3 \
                        --output-dir "$OUT/perf_16m_zipf"
+run radix_batched    2400 python experiments/exp_radix_batched.py 24
 # out-of-core grids: each resumes mid-grid via artifacts/oo_ckpt on retry
 run out_of_core_128m 7200 python experiments/exp_out_of_core.py 27 24
 run out_of_core_1b   21600 python experiments/exp_out_of_core.py 30 26 64

@@ -67,6 +67,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base seed (reference: srand(1234+nodeId), main.cpp:94)")
     p.add_argument("--output-dir", default=None,
                    help="experiment dir for .perf/.info files (default: none)")
+    p.add_argument("--trace", action="store_true",
+                   help="bracket the joins with the profiler (the PAPI "
+                        "total-cycles analog, Measurements.cpp:90-107,137): "
+                        "CTOTAL lands in .perf and the per-op device table "
+                        "in .info; requires --output-dir")
     def positive_int(v):
         iv = int(v)
         if iv < 1:
@@ -78,7 +83,13 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def main(argv=None) -> int:
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if args.trace and not args.output_dir:
+        parser.error("--trace writes its artifacts under --output-dir")
+
+    import contextlib
+    import os
 
     import jax
 
@@ -132,8 +143,15 @@ def main(argv=None) -> int:
     # JPROC by the transfer time on remote-attached devices.
     r_batch, s_batch = engine.place(inner), engine.place(outer)
     result = None
-    for i in range(args.repeat):
-        result = engine.join_arrays(r_batch, s_batch)
+    # --trace: the reference brackets exactly the join with PAPI and writes
+    # CTOTAL into every rank's perf file (Measurements.cpp:90-107,137); here
+    # the profiler bracket wraps the same span and the xplane decoder turns
+    # it into CTOTAL + the per-op table on exit (Measurements.trace).
+    trace_ctx = (meas.trace(os.path.join(args.output_dir, "trace"))
+                 if args.trace else contextlib.nullcontext())
+    with trace_ctx:
+        for i in range(args.repeat):
+            result = engine.join_arrays(r_batch, s_batch)
     if args.repeat > 1:
         # RESULTS accumulates per join; the report's "Tuples" line means THE
         # join's result count.  Times/tuple counters stay cumulative (JRATE
