@@ -64,7 +64,10 @@ def main() -> int:
         stream_chunks_device(r, 0, chunk),
         lambda: stream_chunks_device(s, 0, chunk),
         slab_size=chunk,
-        checkpoint_path=ckpt, checkpoint_tag=tag, progress=True)
+        checkpoint_path=ckpt, checkpoint_tag=tag, progress=True,
+        # unique Relations cap keys below 2**31 (relation.py size guard):
+        # the narrow hint skips the per-pair max-key probe on 32-bit grids
+        key_range="narrow" if key_bits == 32 else "auto")
     dt = time.perf_counter() - t0
     ok = total == size
     print(f"matches: {total:,} expected: {size:,} "

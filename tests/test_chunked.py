@@ -62,11 +62,11 @@ def test_grid_checkpoint_resume(tmp_path):
     calls = {"n": 0}
     real = chunked_join_count
 
-    def failing(rb, sb, slab):
+    def failing(rb, sb, slab, **kw):
         calls["n"] += 1
         if calls["n"] > 2:
             raise RuntimeError("simulated preemption")
-        return real(rb, sb, slab)
+        return real(rb, sb, slab, **kw)
 
     import tpu_radix_join.ops.chunked as C
     C.chunked_join_count, orig = failing, C.chunked_join_count

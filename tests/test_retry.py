@@ -76,9 +76,12 @@ def test_key_contract_violation_not_retried():
     import jax.numpy as jnp
     from tpu_radix_join.data.tuples import TupleBatch
     n = 4
-    cfg = JoinConfig(num_nodes=n, max_retries=3)
+    # key_range="narrow" pins the packed discipline: under the default
+    # "auto" these keys now legitimately route to the full-range count
+    # (tests/test_full_range.py) and the join simply succeeds
+    cfg = JoinConfig(num_nodes=n, max_retries=3, key_range="narrow")
     sz = 1 << 10
-    # keys above the merge packing limit violate the input contract
+    # keys above the merge packing limit violate the narrow input contract
     bad = TupleBatch(key=jnp.full((sz,), 0xF0000000, dtype=jnp.uint32),
                      rid=jnp.arange(sz, dtype=jnp.uint32))
     good = TupleBatch(key=jnp.arange(sz, dtype=jnp.uint32),

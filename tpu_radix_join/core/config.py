@@ -47,6 +47,22 @@ class JoinConfig:
     # --- tuple layout ----------------------------------------------------------
     key_bits: int = 32           # 32 -> single uint32 key lane; 64 -> hi/lo lanes
     payload_bits: int = 27       # rid width contract (Configuration.h:38)
+    # 32-bit count-path key-range discipline (the sort probe packs key+side
+    # into one uint32, capping real keys at 2**31-3 = MAX_MERGE_KEY;
+    # ops/merge_count.py):
+    #   "narrow" — always the packed fast path; keys above the cap flip
+    #              key_contract_violations (loud, never silent).
+    #   "full"   — always the full-range 2-key lexicographic discipline
+    #              (merge_count_per_partition_full): every sub-sentinel
+    #              uint32 key (<= 0xFFFFFFFD) joins exactly, ~1.7x the
+    #              packed sort cost.
+    #   "auto"   — per join: Relation-driven entry points decide statically
+    #              from the relations' key bounds (Relation.key_bound);
+    #              join_arrays probes the device max key once (~2 HBM
+    #              scans) — set narrow/full explicitly to skip the probe.
+    # Irrelevant to key_bits=64 (always the wide 3-lane path), the bucket/
+    # two-level, chunked, and materializing disciplines (never packed).
+    key_range: str = "auto"
 
     # --- distribution ----------------------------------------------------------
     num_nodes: int = 1           # total mesh size (all devices, all hosts)
@@ -127,6 +143,12 @@ class JoinConfig:
             raise ValueError("max_retries must be >= 0")
         if self.generation not in ("auto", "host", "device"):
             raise ValueError(f"unknown generation mode {self.generation!r}")
+        if self.key_range not in ("auto", "narrow", "full"):
+            raise ValueError(f"unknown key range mode {self.key_range!r}")
+        if self.key_range != "auto" and self.key_bits == 64:
+            raise ValueError(
+                "key_range selects among 32-bit count disciplines; "
+                "key_bits=64 always takes the wide hi/lo path")
         if self.skew_threshold is not None:
             if self.skew_threshold <= 0:
                 raise ValueError("skew_threshold must be positive")

@@ -335,6 +335,21 @@ class Relation:
     def local_size(self) -> int:
         return self.global_size // self.num_nodes
 
+    def key_bound(self) -> int:
+        """Exclusive static upper bound on generated key values — the input
+        to the engine's automatic key-range routing (config.key_range
+        "auto": bounds <= 2**31-2 keep the packed 31-bit count path).
+        unique: a permutation of [0, global_size); modulo: residues below
+        min(modulo, global_size); zipf: draws over [0, key_domain).  Wide
+        (64-bit) relations report 2**64: they never use the 32-bit packing."""
+        if self.key_bits == 64:
+            return 1 << 64
+        if self.kind == "unique":
+            return self.global_size
+        if self.kind == "modulo":
+            return min(self.modulo, self.global_size)
+        return self.key_domain
+
     # ------------------------------------------------------------------ host
     def fill_np(self, start: int, count: int, num_threads: int = 0,
                 out_key: Optional[np.ndarray] = None,

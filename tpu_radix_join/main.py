@@ -59,6 +59,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="relation materialization: on-device sharded "
                         "generation when supported (auto/device) or host "
                         "numpy + transfer (host)")
+    p.add_argument("--key-range", choices=["auto", "narrow", "full"],
+                   default="auto",
+                   help="32-bit count-path key discipline: 'narrow' packs "
+                        "key+side into one uint32 (keys < 2^31-2, fastest), "
+                        "'full' takes every sub-sentinel uint32 key via the "
+                        "2-key lexicographic sort (~1.7x), 'auto' decides "
+                        "from the generated relations' static key bounds")
     p.add_argument("--outer-kind", choices=["unique", "modulo", "zipf"],
                    default="unique")
     p.add_argument("--modulo", type=int, default=None)
@@ -115,6 +122,7 @@ def main(argv=None) -> int:
         chunk_size=args.chunk_size,
         max_retries=args.max_retries,
         skew_threshold=args.skew_threshold,
+        key_range=args.key_range,
         generation=args.generation,
         debug_checks=args.debug_checks,
         measure_phases=args.measure_phases,

@@ -55,6 +55,7 @@ from tpu_radix_join.ops.build_probe import (
 from tpu_radix_join.ops.merge_count import (
     MAX_MERGE_KEY,
     merge_count_per_partition,
+    merge_count_per_partition_full,
     merge_count_wide_per_partition,
 )
 from tpu_radix_join.operators import skew
@@ -113,6 +114,12 @@ class HashJoin:
                 f"{config.num_nodes}")
         self._compiled = {}
         self.measurements = measurements   # performance.Measurements or None
+        # resolved per join by _resolve_key_range (config.key_range): True
+        # routes the 32-bit count probe to the full-range lexicographic
+        # discipline instead of the 31-bit packed fast path
+        self._full_range = False
+        # static key bound hint for "auto" (set by Relation entry points)
+        self._static_key_bound: Optional[int] = None
 
     # ------------------------------------------------------------------ build
     def _histogram_fn(self, hot_bits: int = 0):
@@ -183,7 +190,8 @@ class HashJoin:
         disciplines accept the full sub-sentinel range).  Violations flip
         ``ok`` rather than silently overcounting against padding slots."""
         cfg = self.config
-        uses_merge = (not materialize) and r.key_hi is None and cfg.sort_probe
+        uses_merge = ((not materialize) and r.key_hi is None
+                      and cfg.sort_probe and not self._full_range)
         key_cap = jnp.uint32(MAX_MERGE_KEY + 1 if uses_merge else R_PAD_KEY)
         return (jnp.max(_sentinel_lane(r)) < key_cap) & (
             jnp.max(_sentinel_lane(s)) < key_cap)
@@ -346,6 +354,9 @@ class HashJoin:
                     counts, maxw = merge_count_wide_per_partition(
                         r.key, r.key_hi, s.key, s.key_hi, fanout,
                         return_max_weight=True)
+                elif self._full_range:
+                    counts, maxw = merge_count_per_partition_full(
+                        r.key, s.key, fanout, return_max_weight=True)
                 else:
                     counts, maxw = merge_count_per_partition(
                         r.key, s.key, fanout, return_max_weight=True)
@@ -502,7 +513,7 @@ class HashJoin:
                    skew_plan):
         n = self.config.num_nodes
         return (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
-                r.key_hi is None, s.key_hi is None,
+                r.key_hi is None, s.key_hi is None, self._full_range,
                 getattr(r.key, "sharding", None),
                 getattr(s.key, "sharding", None))
 
@@ -920,8 +931,10 @@ class HashJoin:
                 # replicated hot build side joins the local probe; its
                 # padding slots are R sentinels (zero weight)
                 rk = jnp.concatenate([rk, hot_batch.key])
-            counts, maxw = merge_count_per_partition(
-                rk, sp_batch.key, fanout, return_max_weight=True)
+            count = (merge_count_per_partition_full if self._full_range
+                     else merge_count_per_partition)
+            counts, maxw = count(rk, sp_batch.key, fanout,
+                                 return_max_weight=True)
         return (counts, jnp.uint32(0),
                 self._count_risk(maxw, s_hist_bound))
 
@@ -1106,7 +1119,7 @@ class HashJoin:
         compilation — there is none at runtime)."""
         n = self.config.num_nodes
         key = (r.size // n, s.size // n, cap_r, cap_s, local_slack, skew_plan,
-               r.key_hi is None, s.key_hi is None,
+               r.key_hi is None, s.key_hi is None, self._full_range,
                getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         return self._compile_timed(
             key,
@@ -1171,6 +1184,34 @@ class HashJoin:
                     f"batch {'carries' if wide else 'lacks'} a key_hi lane; "
                     f"refusing to run a silently-truncated join")
 
+    def _resolve_key_range(self, r: TupleBatch, s: TupleBatch) -> bool:
+        """Resolve ``config.key_range`` to this join's concrete discipline:
+        True = the full-range lexicographic count (no 31-bit packing cap).
+
+        Only the 32-bit count paths that use the packed merge (the sort
+        probe — fused or split) have a choice to make; everything else
+        (wide keys, bucket/two-level, chunked, materializing) is full-range
+        already.  "auto" prefers a static decision from the Relation key
+        bounds the entry points record (:meth:`join` via
+        ``Relation.key_bound``); for raw arrays it probes the device max
+        key once (~2 HBM scans + one scalar readback) — callers who know
+        their key range set "narrow"/"full" and skip the probe."""
+        cfg = self.config
+        if (cfg.key_bits == 64 or not cfg.sort_probe
+                or r.key_hi is not None):
+            return False
+        if cfg.key_range == "narrow":
+            return False
+        if cfg.key_range == "full":
+            return True
+        if self._static_key_bound is not None:
+            return self._static_key_bound - 1 > MAX_MERGE_KEY
+        if not hasattr(self, "_maxkey_jit"):
+            self._maxkey_jit = jax.jit(
+                lambda a, b: jnp.maximum(jnp.max(a), jnp.max(b)))
+        return int(np.asarray(
+            self._maxkey_jit(r.key, s.key))) > MAX_MERGE_KEY
+
     # ------------------------------------------------------------------- run
     def join_arrays(self, r: TupleBatch, s: TupleBatch) -> JoinResult:
         """Join globally-sharded TupleBatch arrays (leading dim divisible by
@@ -1189,6 +1230,10 @@ class HashJoin:
         # recorded from the host clock (Measurements.cpp:139-141 parity).
         if m:
             m.start("JTOTAL")
+        # the auto key-range probe is join work (2 HBM scans + readback):
+        # it must land inside JTOTAL, like every other pre-pass
+        self._full_range = self._resolve_key_range(r, s)
+        if m:
             m.start("SWINALLOC")
         cap_r, cap_s, skew_plan = self._measure_capacities(
             r, s, shuffles=not self._single_node_sort_probe())
@@ -1388,8 +1433,15 @@ class HashJoin:
         return self.place(rel)
 
     def join(self, inner: Relation, outer: Relation) -> JoinResult:
-        """Join two relation specs (generates shards, shards onto the mesh)."""
-        return self.join_arrays(self.place(inner), self.place(outer))
+        """Join two relation specs (generates shards, shards onto the mesh).
+
+        Records the relations' static key bounds so ``key_range="auto"``
+        resolves without the device max-key probe (:meth:`_resolve_key_range`)."""
+        self._static_key_bound = max(inner.key_bound(), outer.key_bound())
+        try:
+            return self.join_arrays(self.place(inner), self.place(outer))
+        finally:
+            self._static_key_bound = None
 
     def join_materialize(self, inner: Relation,
                          outer: Relation) -> MaterializedJoinResult:

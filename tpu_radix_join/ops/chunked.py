@@ -29,7 +29,9 @@ import numpy as np
 
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.ops.merge_count import (
+    MAX_MERGE_KEY,
     merge_count_chunks,
+    merge_count_per_partition_full,
     merge_count_wide_per_partition,
 )
 
@@ -53,6 +55,24 @@ def _scan_probe(r_keys: jnp.ndarray, s_keys: jnp.ndarray, num_slabs: int):
 
 
 @functools.partial(jax.jit, static_argnames=("num_slabs",))
+def _scan_probe_full(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
+                     num_slabs: int):
+    """Full-key-range twin of :func:`_scan_probe`: the 2-key lexicographic
+    count (merge_count_per_partition_full, fanout 0) for workloads whose
+    keys exceed the 31-bit packing — which would silently map to the
+    reserved pack-pads (zero matches) in the packed discipline."""
+    slabs = s_keys.reshape(num_slabs, -1)
+
+    def step(carry, slab):
+        c, mw = merge_count_per_partition_full(r_keys, slab, 0,
+                                               return_max_weight=True)
+        return carry, (c[0], mw)
+
+    _, (per_slab, mws) = jax.lax.scan(step, jnp.uint32(0), slabs)
+    return per_slab, jnp.max(mws)
+
+
+@functools.partial(jax.jit, static_argnames=("num_slabs",))
 def _scan_probe_wide(r_lo, r_hi, s_lo, s_hi, num_slabs: int):
     """Wide-key (hi/lo lane) twin of :func:`_scan_probe`."""
     slabs = (s_lo.reshape(num_slabs, -1), s_hi.reshape(num_slabs, -1))
@@ -67,7 +87,8 @@ def _scan_probe_wide(r_lo, r_hi, s_lo, s_hi, num_slabs: int):
     return per_slab, jnp.max(mws)
 
 
-def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
+def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
+                       key_range: str = "auto") -> int:
     """Exact match count streaming the outer side in ``slab_size`` slabs.
 
     Ragged sizes (streamed chunks, short final chunks) are padded up to a
@@ -75,7 +96,16 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
     pad-key contract (tuples.py).  Wide (64-bit) batches — e.g. from a
     ``Relation(key_bits=64)`` stream — take the hi/lo lexicographic count;
     mixed-width inputs raise rather than silently truncate.
+
+    ``key_range`` mirrors ``JoinConfig.key_range`` for the 32-bit path:
+    "auto" probes the chunks' max key (2 HBM scans + a readback per call)
+    and routes keys above the 31-bit packing to the full-range count;
+    callers with a static bound — e.g. grid drivers over unique Relations,
+    whose keys never reach 2**31 (relation.py size cap) — pass "narrow"
+    (or "full") to skip the probe on every grid pair.
     """
+    if key_range not in ("auto", "narrow", "full"):
+        raise ValueError(f"unknown key range mode {key_range!r}")
     from tpu_radix_join.data.tuples import pad_sentinel
     if (r.key_hi is None) != (s.key_hi is None):
         raise ValueError(
@@ -97,7 +127,24 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
         per_slab, maxw = _scan_probe_wide(r.key, r.key_hi, keys, s_hi,
                                           (n + pad) // slab_size)
     else:
-        per_slab, maxw = _scan_probe(r.key, keys, (n + pad) // slab_size)
+        # keys above the 31-bit packing would silently land on the reserved
+        # pack-pads (zero matches) in merge_count_chunks; under "auto",
+        # probe the real max (pre-padding — the sentinel fill is always the
+        # uint32 max) and route to the full-range lexicographic count
+        full = key_range == "full"
+        if key_range == "auto":
+            mx = int(np.asarray(jnp.maximum(jnp.max(r.key), jnp.max(s.key))))
+            if mx >= int(pad_sentinel("inner")):
+                raise ValueError(
+                    f"keys reach the pad sentinel range (max {mx:#x}): "
+                    f"uint32 keys must stay <= "
+                    f"{int(pad_sentinel('inner')) - 1:#x}")
+            full = mx > MAX_MERGE_KEY
+        if full:
+            per_slab, maxw = _scan_probe_full(r.key, keys,
+                                              (n + pad) // slab_size)
+        else:
+            per_slab, maxw = _scan_probe(r.key, keys, (n + pad) // slab_size)
     # uint32-overflow guard: every accumulation window (the per-slab total
     # and the 1024-position chunk partials inside it) is bounded by
     # max_weight x window width; a wrapped window would return a wrong count
@@ -114,7 +161,8 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int) -> int:
 def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                       checkpoint_path: str | None = None,
                       checkpoint_tag: str = "",
-                      progress: bool = False) -> int:
+                      progress: bool = False,
+                      key_range: str = "auto") -> int:
     """Both sides streamed; each inner chunk is joined against every outer
     chunk exactly once.
 
@@ -194,7 +242,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
         for j, s in enumerate(s_iter()):
             if j < row_start_j:
                 continue
-            total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]))
+            total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]),
+                                        key_range=key_range)
             save(i, j + 1, total)
             if progress:
                 print(f"[grid] pair ({i}, {j}) done, total={total:,}, "
