@@ -159,3 +159,21 @@ def test_cli_key_range_flag(capsys):
                "--key-range", "full"])
     assert rc == 0
     assert "[RESULTS] Tuples: 4096" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("fanout", [0, 1, 5])
+def test_merge_full_pallas_matches_xla(fanout):
+    """The fused Pallas realization (wide kernel with a zero hi lane) must
+    agree exactly with the XLA scan fallback on full-range keys."""
+    rng = np.random.default_rng(21 + fanout)
+    r = rng.integers(0, 0xFFFFFFFE, size=5000, dtype=np.uint32)
+    s = rng.integers(0, 0xFFFFFFFE, size=5000, dtype=np.uint32)
+    dup = rng.integers(1 << 31, 0xFFFFFFFD, size=32, dtype=np.uint32)
+    r = jnp.asarray(np.concatenate([r, np.repeat(dup, 4)]))
+    s = jnp.asarray(np.concatenate([s, np.repeat(dup, 2)]))
+    cx, mx = merge_count_per_partition_full(
+        r, s, fanout, impl="xla", return_max_weight=True)
+    cp, mp = merge_count_per_partition_full(
+        r, s, fanout, impl="pallas_interpret", return_max_weight=True)
+    np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))
+    assert int(np.asarray(mx)) == int(np.asarray(mp))

@@ -45,6 +45,17 @@ from tpu_radix_join.ops.sorting import (
 )
 
 
+def _resolve_impl(impl: str | None, fanout_bits: int) -> str:
+    """Shared impl auto-routing for every count discipline: the fused Pallas
+    kernels on TPU (their SMEM accumulators cap the partition count at 128),
+    the portable XLA scans elsewhere."""
+    if impl is not None:
+        return impl
+    from tpu_radix_join.ops.pallas.merge_scan import pallas_available
+    return ("pallas" if (pallas_available() and (1 << fanout_bits) <= 128)
+            else "xla")
+
+
 def _pack(r_keys: jnp.ndarray, s_keys: jnp.ndarray) -> jnp.ndarray:
     one = jnp.uint32(1)
     r_ok = r_keys <= jnp.uint32(MAX_MERGE_KEY)
@@ -180,10 +191,7 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     uint64 RESULT_COUNTER, HashJoin.h:26; uint32 counts + this bound are
     the no-device-int64 equivalent).
     """
-    if impl is None:
-        from tpu_radix_join.ops.pallas.merge_scan import pallas_available
-        impl = "pallas" if (pallas_available()
-                            and (1 << fanout_bits) <= 128) else "xla"
+    impl = _resolve_impl(impl, fanout_bits)
     if impl == "xla":
         packed = _sort_unstable(_pack(r_keys, s_keys))
         weight, key = _weights(packed)
@@ -211,6 +219,7 @@ def merge_count_per_partition(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
 
 def merge_count_per_partition_full(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
                                    fanout_bits: int,
+                                   impl: str | None = None,
                                    return_max_weight: bool = False):
     """Full-range uint32 merge count: accepts every sub-sentinel key
     (``key <= 0xFFFFFFFD`` — the R/S pad values stay reserved, tuples.py),
@@ -232,12 +241,39 @@ def merge_count_per_partition_full(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
     routes here only when keys exceed the packing (config.key_range) and it
     beats the 3-lane ``key_bits=64`` escape (~2.6x).  The reference needs no
     analog: its hash-bucket chains never pack key bits (BuildProbe.cpp:81-106).
+
+    ``impl`` as in :func:`merge_count_per_partition`: on TPU the post-sort
+    scan fuses into one Pallas pass by feeding the wide kernel a zero hi
+    lane — run equality on (rot, 0) degenerates to run equality on rot, so
+    ``merge_scan_partitions_wide`` computes exactly these counts; "xla" is
+    the portable scan-passes + boundary-differences fallback.
     """
+    impl = _resolve_impl(impl, fanout_bits)
     rot = jnp.concatenate([_rotate_pid(r_keys, fanout_bits),
                            _rotate_pid(s_keys, fanout_bits)])
     tag = jnp.concatenate([
         jnp.zeros(r_keys.shape, jnp.uint32), jnp.ones(s_keys.shape, jnp.uint32)])
     rot, tag = _sort_lex_unstable(rot, tag, num_keys=2)
+    if impl != "xla":
+        from tpu_radix_join.ops.pallas.merge_scan import (
+            TILE, merge_scan_partitions_wide)
+        n = rot.shape[0]
+        pad = (-n) % TILE
+        if pad:
+            # post-sort padding with the (all-ones rot, tag 1) S-pad image:
+            # the lexicographic maximum (real keys stay below the sentinels,
+            # so their rotations never reach all-ones), zero weight
+            ones = jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)
+            rot = jnp.concatenate([rot, ones])
+            tag = jnp.concatenate([tag, jnp.ones((pad,), jnp.uint32)])
+        hi = jnp.concatenate([jnp.zeros((n,), jnp.uint32),
+                              jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)])
+        counts, maxw = merge_scan_partitions_wide(
+            rot, hi, tag, num_partitions=1 << fanout_bits,
+            interpret=(impl == "pallas_interpret"))
+        if return_max_weight:
+            return counts, maxw
+        return counts
     prev = jnp.concatenate(
         [jnp.full((1,), 0xFFFFFFFF, jnp.uint32), rot[:-1]])
     # position 0: the synthetic prev (all-ones) can only suppress a run
@@ -296,10 +332,7 @@ def merge_count_wide_per_partition(
     differ in the hi lane, so padding contributes zero weight on either path.
     ``return_max_weight`` as in :func:`merge_count_per_partition`.
     """
-    if impl is None:
-        from tpu_radix_join.ops.pallas.merge_scan import pallas_available
-        impl = "pallas" if (pallas_available()
-                            and (1 << fanout_bits) <= 128) else "xla"
+    impl = _resolve_impl(impl, fanout_bits)
     hi = jnp.concatenate([r_hi, s_hi])
     lo = jnp.concatenate([r_lo, s_lo])
     tag = jnp.concatenate([
