@@ -49,6 +49,23 @@ def main(port: str, rank: str, nproc: str) -> None:
     assert mat.ok, mat.diagnostics
     assert mat.matches == size, mat.matches
 
+    # full-range auto routing across processes: the device max-key probe's
+    # readback must ride the multi-host gather (_to_host), and the 2-key
+    # lexicographic count must stay exact through the cross-process shuffle
+    import jax.numpy as jnp
+    import numpy as np
+    from tpu_radix_join.data.tuples import TupleBatch
+    big = ((1 << 31) + 11 * np.arange(size, dtype=np.uint64)).astype(np.uint32)
+    shuffled = np.random.default_rng(0).permutation(big)
+    shuffled[: size // 4] = 5
+    fr = HashJoin(JoinConfig(num_nodes=n, num_hosts=nproc)).join_arrays(
+        TupleBatch(key=jnp.asarray(big),
+                   rid=jnp.arange(size, dtype=jnp.uint32)),
+        TupleBatch(key=jnp.asarray(shuffled),
+                   rid=jnp.arange(size, dtype=jnp.uint32)))
+    assert fr.ok, fr.diagnostics
+    assert fr.matches == size - size // 4, fr.matches
+
     all_m = m.gather_all()
     assert len(all_m) == nproc, len(all_m)
     assert sorted(mm.node_id for mm in all_m) == list(range(nproc))

@@ -177,3 +177,68 @@ def test_merge_full_pallas_matches_xla(fanout):
         r, s, fanout, impl="pallas_interpret", return_max_weight=True)
     np.testing.assert_array_equal(np.asarray(cx), np.asarray(cp))
     assert int(np.asarray(mx)) == int(np.asarray(mp))
+
+
+def test_full_range_composes_with_skew_split():
+    """The skew split (replicated hot inner riding the local probe) must
+    stay exact when the probe runs the full-range discipline."""
+    n = 1 << 12
+    half = n // 2
+    big = lambda a: ((1 << 31) + a.astype(np.uint64) * 3).astype(np.uint32)
+    r = TupleBatch(key=jnp.asarray(big(np.arange(n))),
+                   rid=jnp.arange(n, dtype=jnp.uint32))
+    hot = np.concatenate([np.full(half, big(np.array([3]))[0], np.uint32),
+                          big(np.arange(half))])
+    s = TupleBatch(key=jnp.asarray(hot), rid=jnp.arange(n, dtype=jnp.uint32))
+    cfg = JoinConfig(num_nodes=8, skew_threshold=4.0, allocation_factor=4.0,
+                     key_range="full")
+    res = HashJoin(cfg).join_arrays(r, s)
+    assert res.ok, res.diagnostics
+    # key 2**31+9 (= big(3)) matches half+1 outer tuples; the other half-1
+    # distinct outer keys match once each
+    assert res.matches == (half + 1) + (half - 1)
+
+
+def test_merge_full_inside_shard_map():
+    """The full-range count must trace inside a shard_map body — the chip
+    pipeline's exact shape (hash_join._local_process).  The portable XLA
+    realization is asserted here; interpret-mode Pallas cannot run under
+    shard_map at all (the HLO interpreter re-traces kernel-internal
+    constants without mesh annotations — a pre-existing property shared by
+    EVERY kernel in ops/pallas, asserted below so a JAX upgrade that lifts
+    it is noticed), while compiled Pallas traces its kernel outside the
+    mesh and is chip-validated (artifacts/chip_r3 ran the packed kernel
+    inside the fused shard_map pipeline)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from tpu_radix_join.parallel.mesh import make_mesh
+
+    n_dev, n = 4, 4096
+    rng = np.random.default_rng(2)
+    r = ((1 << 31) + 3 * np.arange(n, dtype=np.uint64)).astype(np.uint32)
+    s = rng.permutation(r)
+    mesh = make_mesh(n_dev)
+
+    def body(impl):
+        def run(rk, sk):
+            c, mw = merge_count_per_partition_full(
+                rk, sk, 3, impl=impl, return_max_weight=True)
+            return jax.lax.psum(c, "nodes"), jax.lax.pmax(mw, "nodes")
+        return jax.jit(jax.shard_map(
+            run, mesh=mesh, in_specs=(P("nodes"), P("nodes")),
+            out_specs=(P(), P())))
+
+    counts, mw = body("xla")(jnp.asarray(r), jnp.asarray(s))
+    # keys are globally distinct and both sides shard identically, so each
+    # shard-local count sees only its own slice's permuted intersection;
+    # the psum total is exactly the number of keys co-resident on a shard
+    total = int(np.asarray(counts).astype(np.uint64).sum())
+    shard = n // n_dev
+    want = sum(
+        len(np.intersect1d(r[i * shard:(i + 1) * shard],
+                           s[i * shard:(i + 1) * shard]))
+        for i in range(n_dev))
+    assert total == want, (total, want)
+    assert int(np.asarray(mw)) == 1
+    with pytest.raises(ValueError, match="varying manual axes"):
+        body("pallas_interpret")(jnp.asarray(r), jnp.asarray(s))

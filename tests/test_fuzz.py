@@ -19,11 +19,16 @@ def _random_case(case: int):
     kinds = ["unique", "modulo", "zipf"]
     s_kind = kinds[int(rng.integers(0, 3))]
     s_kw = {}
+    big_domain = False
     if s_kind == "modulo":
         s_kw["modulo"] = int(rng.integers(1, size))
     elif s_kind == "zipf":
         s_kw["zipf_theta"] = float(rng.uniform(0.2, 1.2))
-        s_kw["key_domain"] = size
+        # sometimes draw over a > 2**31 key domain: exercises the r5
+        # full-range routing (keys above the 31-bit packing) under the oracle
+        big_domain = bool(rng.random() < 0.3)
+        s_kw["key_domain"] = ((1 << 31) + int(rng.integers(1, 1 << 30))
+                              if big_domain else size)
     two_level = bool(rng.integers(0, 2))
     fanout = int(rng.integers(2, 6))
     window = str(rng.choice(["measured", "static"]))
@@ -37,6 +42,15 @@ def _random_case(case: int):
             and fanout <= 5 and rng.random() < 0.3):
         skew = float(rng.uniform(1.5, 4.0))
     key_bits = 64 if rng.random() < 0.3 else 32
+    # key_range only gates the 32-bit paths; "narrow" would correctly flag
+    # (not silently drop) big-domain keys, but the fuzz asserts ok=True, so
+    # big domains draw from the routing modes that accept them
+    if key_bits == 64:
+        key_range = "auto"
+    elif big_domain:
+        key_range = str(rng.choice(["auto", "full"]))
+    else:
+        key_range = str(rng.choice(["auto", "narrow", "full"]))
     cfg = JoinConfig(
         num_nodes=nodes,
         network_fanout_bits=fanout,
@@ -49,6 +63,7 @@ def _random_case(case: int):
         chunk_size=chunk,
         skew_threshold=skew,
         key_bits=key_bits,
+        key_range=key_range,
         measure_phases=bool(rng.random() < 0.3),
     )
     r = Relation(size, nodes, "unique", seed=int(rng.integers(1, 1 << 20)),

@@ -1,0 +1,40 @@
+# Shared machinery for the chip-gated task runners (sourced, not executed):
+# tunnel probe, bounded wait, and the retrying .done-marker task wrapper.
+# Callers set OUT (artifact dir) before sourcing; MAX_ATTEMPTS may be
+# overridden after.  NOTE: a bash script that is already RUNNING reads its
+# file incrementally — deploy edits to the runner scripts with `mv` (atomic
+# rename keeps the running process on the old inode), never in-place.
+export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
+mkdir -p "$OUT"
+MAX_ATTEMPTS=${MAX_ATTEMPTS:-6}
+
+probe() { timeout 60 python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; }
+
+wait_tunnel() {
+  for i in $(seq 1 400); do
+    if probe; then return 0; fi
+    echo "$(date -u +%H:%M:%S) tunnel down, waiting..."
+    sleep 90
+  done
+  echo "tunnel never came back"; return 1
+}
+
+run() {
+  name=$1; shift
+  tmo=$1; shift
+  if [ -f "$OUT/$name.done" ]; then echo "=== $name: already done, skipping ==="; return 0; fi
+  echo "=== $name: $* ==="
+  for attempt in $(seq 1 $MAX_ATTEMPTS); do
+    wait_tunnel || return 1
+    # per-attempt logs: a retry must not destroy the prior attempt's
+    # failure evidence; $name.log always points at the latest attempt
+    timeout "$tmo" "$@" > "$OUT/$name.a$attempt.log" 2>&1
+    rc=$?
+    ln -sf "$name.a$attempt.log" "$OUT/$name.log"
+    echo "$name attempt $attempt rc=$rc ($(date -u +%H:%M:%S))"
+    if [ "$rc" = 0 ]; then touch "$OUT/$name.done"; return 0; fi
+    sleep 30
+  done
+  echo "$name FAILED after $MAX_ATTEMPTS attempts"
+  return 1
+}

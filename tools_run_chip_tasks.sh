@@ -1,49 +1,13 @@
 #!/bin/bash
 # Round-5 chip-gated task runner (VERDICT r4 #1: invoke at round START and
-# keep re-invoking until every .done marker exists).  Behavior:
-#   * re-probes the tunnel before every task AND between retries;
-#   * retries each task up to MAX_ATTEMPTS times;
-#   * drops a .done marker per task so a rerun of the whole script resumes
-#     at the first unfinished task (the out-of-core grids additionally
-#     resume mid-task via chunked_join_grid checkpoints).
-# Outputs under artifacts/chip_r5/.
+# keep re-invoking until every .done marker exists).  Re-probes the tunnel
+# before every task and between retries; .done markers make reruns resume at
+# the first unfinished task (the out-of-core grids additionally resume
+# mid-task via chunked_join_grid checkpoints).  Outputs under artifacts/chip_r5/.
 set -u
 cd /root/repo
-export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 OUT=artifacts/chip_r5
-mkdir -p "$OUT"
-MAX_ATTEMPTS=6
-
-probe() { timeout 60 python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; }
-
-wait_tunnel() {
-  for i in $(seq 1 400); do
-    if probe; then return 0; fi
-    echo "$(date -u +%H:%M:%S) tunnel down, waiting..."
-    sleep 90
-  done
-  echo "tunnel never came back"; return 1
-}
-
-run() {
-  name=$1; shift
-  tmo=$1; shift
-  if [ -f "$OUT/$name.done" ]; then echo "=== $name: already done, skipping ==="; return 0; fi
-  echo "=== $name: $* ==="
-  for attempt in $(seq 1 $MAX_ATTEMPTS); do
-    wait_tunnel || return 1
-    # per-attempt logs: a retry must not destroy the prior attempt's
-    # failure evidence; $name.log always points at the latest attempt
-    timeout "$tmo" "$@" > "$OUT/$name.a$attempt.log" 2>&1
-    rc=$?
-    ln -sf "$name.a$attempt.log" "$OUT/$name.log"
-    echo "$name attempt $attempt rc=$rc ($(date -u +%H:%M:%S))"
-    if [ "$rc" = 0 ]; then touch "$OUT/$name.done"; return 0; fi
-    sleep 30
-  done
-  echo "$name FAILED after $MAX_ATTEMPTS attempts"
-  return 1
-}
+source tools_chip_lib.sh
 
 SIXTEEN=$((1<<24))
 run bench            2400 python bench.py

@@ -1,9 +1,9 @@
 #!/bin/bash
-# Round-5 follow-on chip tasks.  Kept out of tools_run_chip_tasks.sh because
-# that script was already executing when these were added (bash reads a
-# running script incrementally — editing it mid-run corrupts execution).
-# Waits for the primary runner to finish (its pid or the final marker), then
-# runs with the same probe/retry/.done discipline into the same OUT dir.
+# Round-5 follow-on chip tasks, added while tools_run_chip_tasks.sh was
+# already executing (a running bash script cannot be edited in place).
+# Waits for ANY live primary-runner process to exit before starting, so the
+# two never time 16M benchmarks concurrently through the one chip; then runs
+# with the shared probe/retry/.done discipline into the same OUT dir.
 #   * cli_16m_twolevel_fused — the bucket path WITHOUT --measure-phases:
 #     the fused-truth number for the split-vs-fused gap analysis
 #     (exp_phase_net.py; VERDICT r4 #7).
@@ -11,46 +11,13 @@
 #     (--key-range full), priced against perf_16m_sort's packed path.
 set -u
 cd /root/repo
-export PYTHONPATH=/root/repo${PYTHONPATH:+:$PYTHONPATH}
 OUT=artifacts/chip_r5
-mkdir -p "$OUT"
-MAX_ATTEMPTS=6
-PRIMARY_PID=${1:-}
+source tools_chip_lib.sh
 
-if [ -n "$PRIMARY_PID" ]; then
-  while kill -0 "$PRIMARY_PID" 2>/dev/null; do
-    sleep 60
-  done
-fi
-
-probe() { timeout 60 python -c "import jax; print(jax.devices()[0])" >/dev/null 2>&1; }
-
-wait_tunnel() {
-  for i in $(seq 1 400); do
-    if probe; then return 0; fi
-    echo "$(date -u +%H:%M:%S) tunnel down, waiting..."
-    sleep 90
-  done
-  echo "tunnel never came back"; return 1
-}
-
-run() {
-  name=$1; shift
-  tmo=$1; shift
-  if [ -f "$OUT/$name.done" ]; then echo "=== $name: already done, skipping ==="; return 0; fi
-  echo "=== $name: $* ==="
-  for attempt in $(seq 1 $MAX_ATTEMPTS); do
-    wait_tunnel || return 1
-    timeout "$tmo" "$@" > "$OUT/$name.a$attempt.log" 2>&1
-    rc=$?
-    ln -sf "$name.a$attempt.log" "$OUT/$name.log"
-    echo "$name attempt $attempt rc=$rc ($(date -u +%H:%M:%S))"
-    if [ "$rc" = 0 ]; then touch "$OUT/$name.done"; return 0; fi
-    sleep 30
-  done
-  echo "$name FAILED after $MAX_ATTEMPTS attempts"
-  return 1
-}
+# $ must not match this script's own cmdline ("..._extra.sh 19533")
+while pgrep -f 'bash tools_run_chip_tasks\.sh$' >/dev/null; do
+  sleep 60
+done
 
 SIXTEEN=$((1<<24))
 run cli_16m_twolevel_fused 2400 python -m tpu_radix_join.main \

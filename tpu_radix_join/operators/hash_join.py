@@ -1209,7 +1209,9 @@ class HashJoin:
         if not hasattr(self, "_maxkey_jit"):
             self._maxkey_jit = jax.jit(
                 lambda a, b: jnp.maximum(jnp.max(a), jnp.max(b)))
-        return int(np.asarray(
+        # _to_host: the replicated scalar still reports non-addressable
+        # shards in multi-process worlds, where bare np.asarray raises
+        return int(self._to_host(
             self._maxkey_jit(r.key, s.key))) > MAX_MERGE_KEY
 
     # ------------------------------------------------------------------- run
@@ -1234,6 +1236,10 @@ class HashJoin:
         # it must land inside JTOTAL, like every other pre-pass
         self._full_range = self._resolve_key_range(r, s)
         if m:
+            if self.config.key_bits == 32 and self.config.sort_probe:
+                # perf artifacts self-describe which count discipline ran
+                m.meta["key_range"] = ("full" if self._full_range
+                                       else "narrow")
             m.start("SWINALLOC")
         cap_r, cap_s, skew_plan = self._measure_capacities(
             r, s, shuffles=not self._single_node_sort_probe())

@@ -266,8 +266,13 @@ def merge_count_per_partition_full(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
             ones = jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)
             rot = jnp.concatenate([rot, ones])
             tag = jnp.concatenate([tag, jnp.ones((pad,), jnp.uint32)])
-        hi = jnp.concatenate([jnp.zeros((n,), jnp.uint32),
-                              jnp.full((pad,), 0xFFFFFFFF, jnp.uint32)])
+        # hi derived FROM rot — not a fresh constant — so it inherits rot's
+        # varying-manual-axes annotation inside shard_map-traced pipelines
+        # (a fresh zero lane fails pallas_call's vma consistency check):
+        # zero for real keys, all-ones on the pad image (rot == all-ones is
+        # unreachable for real keys by the sentinel contract)
+        hi = jnp.where(rot == jnp.uint32(0xFFFFFFFF), rot,
+                       rot & jnp.uint32(0))
         counts, maxw = merge_scan_partitions_wide(
             rot, hi, tag, num_partitions=1 << fanout_bits,
             interpret=(impl == "pallas_interpret"))
