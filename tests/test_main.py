@@ -100,3 +100,22 @@ def test_cli_trace_requires_output_dir(capsys):
     import pytest
     with pytest.raises(SystemExit):
         main(["--tuples-per-node", "1024", "--trace"])
+
+
+def test_cli_pipeline_repeats(capsys):
+    """--pipeline-repeats: the amortized dispatch mode must report the same
+    single-join tuple count and oracle status as the synchronous loop."""
+    rc = main(["--tuples-per-node", "1024", "--nodes", "2", "--repeat", "3",
+               "--pipeline-repeats"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "[RESULTS] Tuples: 2048" in out
+    assert "Expected: 2048 (OK)" in out
+    assert "Throughput" in out
+
+
+def test_cli_pipeline_repeats_rejects_measure_phases():
+    import pytest
+    with pytest.raises(SystemExit):
+        main(["--tuples-per-node", "1024", "--repeat", "3",
+              "--pipeline-repeats", "--measure-phases"])

@@ -26,4 +26,7 @@ run cli_16m_twolevel_fused 2400 python -m tpu_radix_join.main \
 run cli_16m_full 2400 python -m tpu_radix_join.main \
     --tuples-per-node $SIXTEEN --nodes 1 --key-range full --repeat 3 \
     --output-dir "$OUT/perf_16m_full"
+run cli_16m_pipelined 2400 python -m tpu_radix_join.main \
+    --tuples-per-node $SIXTEEN --nodes 1 --repeat 20 --pipeline-repeats \
+    --output-dir "$OUT/perf_16m_pipelined"
 echo "ALL_EXTRA_CHIP_TASKS_DONE $(date -u +%H:%M:%S)"

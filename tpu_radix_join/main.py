@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
         return iv
 
     p.add_argument("--repeat", type=positive_int, default=1)
+    p.add_argument("--pipeline-repeats", action="store_true",
+                   help="dispatch the --repeat joins asynchronously and "
+                        "fence once (amortized-throughput methodology, "
+                        "bench.py): removes the ~100ms/join host dispatch "
+                        "round-trip from the reported rate; no per-join "
+                        "retry loop")
     return p
 
 
@@ -94,6 +100,10 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.trace and not args.output_dir:
         parser.error("--trace writes its artifacts under --output-dir")
+    if args.pipeline_repeats and args.measure_phases:
+        parser.error("--pipeline-repeats dispatches without intermediate "
+                     "fences; the --measure-phases split timers need a "
+                     "fence per program — drop one of the two")
 
     import contextlib
     import os
@@ -158,8 +168,12 @@ def main(argv=None) -> int:
     trace_ctx = (meas.trace(os.path.join(args.output_dir, "trace"))
                  if args.trace else contextlib.nullcontext())
     with trace_ctx:
-        for i in range(args.repeat):
-            result = engine.join_arrays(r_batch, s_batch)
+        if args.pipeline_repeats and args.repeat > 1:
+            result = engine.join_arrays_pipelined(r_batch, s_batch,
+                                                  args.repeat)
+        else:
+            for i in range(args.repeat):
+                result = engine.join_arrays(r_batch, s_batch)
     if args.repeat > 1:
         # RESULTS accumulates per join; the report's "Tuples" line means THE
         # join's result count.  Times/tuple counters stay cumulative (JRATE

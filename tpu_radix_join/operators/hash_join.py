@@ -1215,9 +1215,39 @@ class HashJoin:
             self._maxkey_jit(r.key, s.key))) > MAX_MERGE_KEY
 
     # ------------------------------------------------------------------- run
-    def join_arrays(self, r: TupleBatch, s: TupleBatch) -> JoinResult:
+    def join_arrays_pipelined(self, r: TupleBatch, s: TupleBatch,
+                              repeats: int) -> JoinResult:
+        """Alias for ``join_arrays(..., repeats=...)`` (kept for API
+        discoverability of the amortized-dispatch mode)."""
+        return self.join_arrays(r, s, repeats=repeats)
+
+    def join_arrays(self, r: TupleBatch, s: TupleBatch,
+                    repeats: int = 1) -> JoinResult:
         """Join globally-sharded TupleBatch arrays (leading dim divisible by
-        the mesh size)."""
+        the mesh size).
+
+        ``repeats > 1`` pipelines that many joins of the same batches as
+        asynchronous dispatches closed by ONE fence — the
+        amortized-throughput methodology (bench.py) through the full driver
+        flow.  Through a host-attached chip each synchronous join pays a
+        non-pipelining ~100 ms dispatch round-trip (PERF_NOTES), so the
+        driver-visible rate reads ~2x below the chip's amortized truth;
+        pipelined mode sizes and compiles once and divides.  No retry loop
+        there (a capacity shortfall surfaces identically in every attempt's
+        flags), and no phase-split (the split timers need a fence per
+        program — the combination raises).  Cumulative counters keep the
+        synchronous convention: tuple/exchange counters accumulate once per
+        dispatched join, so JRATE = cumulative tuples / cumulative time.
+        The reference driver runs exactly one join (main.cpp), so repeats
+        carry no parity constraint.
+        """
+        if repeats < 1:
+            raise ValueError("repeats must be >= 1")
+        if repeats > 1 and self.config.measure_phases:
+            raise ValueError(
+                "pipelined repeats dispatch without intermediate fences; "
+                "the measure_phases split timers need a fence per program "
+                "— loop synchronous joins instead")
         n = self.config.num_nodes
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
@@ -1246,6 +1276,23 @@ class HashJoin:
         if m:
             m.stop("SWINALLOC")
         local_slack = 1
+        if repeats > 1:
+            # amortized-dispatch mode: one compiled program, ``repeats``
+            # async dispatches, one fence; flags read once (identical
+            # static shapes make every attempt fail or succeed alike)
+            fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
+                                    skew_plan)
+            if m:
+                m.start("JPROC")
+            counts = flags = None
+            for _ in range(repeats):
+                counts, flags = fn(r, s)
+            if m:
+                m.stop("JPROC", fence=(counts, flags))
+            flags = np.asarray(flags)
+            diag = self._flags_to_diag(flags)
+            return self._finish_join(r, s, counts, flags, diag,
+                                     cap_r, cap_s, repeats)
         # the split is honored with or without a registry (a profiler-trace
         # user still gets two separate programs); only the host timers need m
         use_split = (self.config.measure_phases
@@ -1280,18 +1327,29 @@ class HashJoin:
                 # when retries are exhausted the last attempt IS the result
                 # — keep its time (see _rollback_attempt)
                 self._rollback_attempt(m, dts)
+        return self._finish_join(r, s, counts, flags, diag, cap_r, cap_s, 1)
+
+    def _finish_join(self, r: TupleBatch, s: TupleBatch, counts, flags,
+                     diag: dict, cap_r: int, cap_s: int,
+                     repeats: int) -> JoinResult:
+        """Shared join epilogue: host readback, cumulative counters (once
+        per dispatched join — the reference counts its exchange in the hot
+        loop per Put, Measurements.cpp:272-349), derived rates, result."""
+        m = self.measurements
         counts = self._to_host(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
             m.stop("JTOTAL")
-            m.incr("RESULTS", matches)
-            m.incr("RTUPLES", r.size)
-            m.incr("STUPLES", s.size)
+            m.incr("RESULTS", matches * repeats)
+            m.incr("RTUPLES", r.size * repeats)
+            m.incr("STUPLES", s.size * repeats)
             if not self._single_node_sort_probe():
                 # the n==1 specialization performs no exchange at all —
                 # recording its dummy capacities would invent network stats
-                m.record_exchange(n, cap_r, cap_s,
-                                  tuple_bytes=8 if r.key_hi is None else 12)
+                for _ in range(repeats):
+                    m.record_exchange(
+                        self.config.num_nodes, cap_r, cap_s,
+                        tuple_bytes=8 if r.key_hi is None else 12)
             m.derive_rates()
         return JoinResult(matches=matches, ok=not flags.any(),
                           partition_counts=counts, diagnostics=diag)
