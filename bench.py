@@ -128,6 +128,29 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
 
 def main():
     _wait_for_backend()
+    # Cooperative chip reservation: long-running grid experiments
+    # (chunked_join_grid) park between chunk pairs while this PID-stamped
+    # file exists, so a background out-of-core run on the shared single
+    # chip cannot contaminate the official benchmark's timings.  The
+    # reciprocal GRID_RUNNING file tells us whether any live grid actually
+    # holds the chip — only then is a drain wait paid, bounded by the
+    # longest single chunk pair.
+    import atexit
+
+    from tpu_radix_join.utils.locks import (
+        pid_file_alive, remove_pid_file, write_pid_file)
+    here = os.path.dirname(os.path.abspath(__file__))
+    pause_file = os.path.join(here, "artifacts", "BENCH_RUNNING")
+    write_pid_file(pause_file)
+    atexit.register(remove_pid_file, pause_file)
+    grid_file = os.path.join(here, "artifacts", "GRID_RUNNING")
+    drain_deadline = time.monotonic() + 120
+    while (pid_file_alive(grid_file)
+           and not os.path.exists(grid_file + ".parked")
+           and time.monotonic() < drain_deadline):
+        print("note: live grid run holds the chip; draining...",
+              file=sys.stderr)
+        time.sleep(10)
 
     import jax
     import jax.numpy as jnp

@@ -233,21 +233,68 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
         os.replace(tmp, checkpoint_path)
 
     import time as _time
+
+    from tpu_radix_join.utils.locks import (
+        pid_file_alive, remove_pid_file, write_pid_file)
+
+    pause_file = os.environ.get("TPU_RJ_PAUSE_FILE")
+    # reciprocal presence file: bench.py drains the chip only when a live
+    # grid actually holds it (utils/locks.py)
+    grid_file = os.environ.get("TPU_RJ_GRID_FILE")
+    if grid_file and not write_pid_file(grid_file):
+        grid_file = None
+
+    def yield_chip():
+        """Cooperative chip yield: while the pause file exists (bench.py
+        holds it during its timed window), park between chunk pairs so a
+        long grid run cannot contaminate the official benchmark's timings
+        on the shared single chip.  Liveness comes from the PID stamped in
+        the file — a bench killed hard never parks the grid beyond one
+        check, and a long-running live bench is never declared stale."""
+        waited = False
+        while pause_file and os.path.exists(pause_file):
+            alive = pid_file_alive(pause_file)
+            if alive is False:
+                print("[grid] removing dead bench's pause file", flush=True)
+                remove_pid_file(pause_file)
+                break
+            if alive is None and not os.path.exists(pause_file):
+                break   # removed between the exists() check and the read
+            if not waited:
+                print(f"[grid] paused: {pause_file} present", flush=True)
+                waited = True
+                if grid_file:
+                    # tells the bench the chip is actually drained (the
+                    # presence file alone only says the grid process lives)
+                    write_pid_file(grid_file + ".parked")
+            _time.sleep(5)
+        if waited:
+            if grid_file:
+                remove_pid_file(grid_file + ".parked")
+            print("[grid] resumed", flush=True)
+
     t0 = _time.perf_counter()
     last_i = start_i
-    for i, r in enumerate(r_chunks):
-        if i < start_i:
-            continue
-        row_start_j = start_j if i == start_i else 0
-        for j, s in enumerate(s_iter()):
-            if j < row_start_j:
+    try:
+        for i, r in enumerate(r_chunks):
+            if i < start_i:
                 continue
-            total += chunked_join_count(r, s, min(slab_size, s.key.shape[0]),
-                                        key_range=key_range)
-            save(i, j + 1, total)
-            if progress:
-                print(f"[grid] pair ({i}, {j}) done, total={total:,}, "
-                      f"t={_time.perf_counter() - t0:.1f}s", flush=True)
-        last_i = i + 1
-    save(last_i, 0, total, done=True)
-    return total
+            row_start_j = start_j if i == start_i else 0
+            for j, s in enumerate(s_iter()):
+                if j < row_start_j:
+                    continue
+                yield_chip()
+                total += chunked_join_count(r, s,
+                                            min(slab_size, s.key.shape[0]),
+                                            key_range=key_range)
+                save(i, j + 1, total)
+                if progress:
+                    print(f"[grid] pair ({i}, {j}) done, total={total:,}, "
+                          f"t={_time.perf_counter() - t0:.1f}s", flush=True)
+            last_i = i + 1
+        save(last_i, 0, total, done=True)
+        return total
+    finally:
+        if grid_file:
+            remove_pid_file(grid_file)
+            remove_pid_file(grid_file + ".parked")

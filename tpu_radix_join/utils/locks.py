@@ -1,0 +1,54 @@
+"""PID-stamped coordination files for the shared single chip.
+
+bench.py and the out-of-core grid (ops/chunked.chunked_join_grid) must not
+time against each other on one device: the bench holds a pause file while
+its timed window runs and the grid parks between chunk pairs; the grid
+holds a presence file so the bench knows whether a drain wait is needed at
+all.  Both files carry the owner's PID, so liveness is exact — a holder
+killed hard (no atexit) never wedges the other side, and a legitimately
+long-running holder is never declared stale by a clock heuristic.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def write_pid_file(path: str) -> bool:
+    """Stamp ``path`` with this process's PID; False if unwritable."""
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write(str(os.getpid()))
+        return True
+    except OSError:
+        return False
+
+
+def remove_pid_file(path: str) -> None:
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+
+
+def pid_file_alive(path: str) -> Optional[bool]:
+    """Is the process that stamped ``path`` still alive?
+
+    True/False when the file names a checkable PID; None when the file is
+    missing, unreadable, or carries no PID (callers fall back to their own
+    policy).  A PID owned by another user counts as alive (EPERM)."""
+    try:
+        pid = int(open(path).read().strip() or "0")
+    except (OSError, ValueError):
+        return None
+    if pid <= 0:
+        return None
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
