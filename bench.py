@@ -138,19 +138,26 @@ def main():
     import atexit
 
     from tpu_radix_join.utils.locks import (
-        pid_file_alive, remove_pid_file, write_pid_file)
-    here = os.path.dirname(os.path.abspath(__file__))
-    pause_file = os.path.join(here, "artifacts", "BENCH_RUNNING")
+        bench_pause_file, grid_presence_file, pid_file_alive,
+        remove_pid_file, write_pid_file)
+    pause_file = bench_pause_file()
     write_pid_file(pause_file)
     atexit.register(remove_pid_file, pause_file)
-    grid_file = os.path.join(here, "artifacts", "GRID_RUNNING")
-    drain_deadline = time.monotonic() + 120
-    while (pid_file_alive(grid_file)
-           and not os.path.exists(grid_file + ".parked")
-           and time.monotonic() < drain_deadline):
+    grid_file = grid_presence_file()
+
+    def _grid_busy():
+        return (pid_file_alive(grid_file)
+                and not os.path.exists(grid_file + ".parked"))
+
+    drain_deadline = time.monotonic() + 600
+    while _grid_busy() and time.monotonic() < drain_deadline:
         print("note: live grid run holds the chip; draining...",
               file=sys.stderr)
         time.sleep(10)
+    if _grid_busy():
+        print("WARNING: grid run still mid-chunk-pair after the drain "
+              "deadline — timings below may be contaminated by chip "
+              "contention", file=sys.stderr)
 
     import jax
     import jax.numpy as jnp

@@ -32,17 +32,10 @@ from tpu_radix_join.utils.platform import apply_platform_override
 
 apply_platform_override()   # honor JAX_PLATFORMS (e.g. CPU smoke runs)
 
-# cooperative chip yield (utils/locks.py): bench.py holds BENCH_RUNNING
-# during its timed window and the grid parks between chunk pairs; the grid
-# holds GRID_RUNNING (+ .parked while yielded) so the bench knows whether a
-# drain wait is needed at all (ops/chunked.chunked_join_grid)
-_artifacts = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "artifacts")
-os.environ.setdefault("TPU_RJ_PAUSE_FILE",
-                      os.path.join(_artifacts, "BENCH_RUNNING"))
-os.environ.setdefault("TPU_RJ_GRID_FILE",
-                      os.path.join(_artifacts, "GRID_RUNNING"))
-
+# cooperative chip yield: bench.py holds BENCH_RUNNING during its timed
+# window and the grid parks between chunk pairs, advertising GRID_RUNNING
+# (+ .parked while yielded); both sides resolve the paths through
+# utils/locks.py, so no per-experiment wiring is needed here
 from tpu_radix_join.data.relation import Relation
 from tpu_radix_join.data.streaming import stream_chunks_device
 from tpu_radix_join.ops.chunked import chunked_join_grid

@@ -36,14 +36,23 @@ ITERS = 8
 
 
 def main() -> int:
-    log2 = int(sys.argv[1]) if len(sys.argv) > 1 else 24
-    out_dir = sys.argv[2] if len(sys.argv) > 2 else os.path.join(
+    args = [a for a in sys.argv[1:] if a != "--two-level"]
+    two_level = "--two-level" in sys.argv[1:]
+    log2 = int(args[0]) if args else 24
+    out_dir = args[1] if len(args) > 1 else os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "artifacts", "chip_r4", f"trace_{1 << log2 >> 20}m")
     size = 1 << log2
-    print(f"device: {jax.devices()[0]}, size: {size:,}, out: {out_dir}",
-          flush=True)
-    eng = HashJoin(JoinConfig(num_nodes=1))
+    print(f"device: {jax.devices()[0]}, size: {size:,}, out: {out_dir}, "
+          f"two_level: {two_level}", flush=True)
+    # --two-level: trace the bucket discipline's fused program instead — the
+    # per-op table answers how its device time splits between the second
+    # radix pass and the per-bucket probe (VERDICT r4 weak #3's "real work
+    # vs round-trips" question, net of any dispatch entirely by design:
+    # the trace sees only device ops)
+    eng = HashJoin(JoinConfig(num_nodes=1, two_level=two_level,
+                              local_fanout_bits=5, allocation_factor=3.0)
+                   if two_level else JoinConfig(num_nodes=1))
     r = eng.place(Relation(size, 1, "unique", seed=1))
     s = eng.place(Relation(size, 1, "unique", seed=2))
     cap_r, cap_s, _ = eng._measure_capacities(

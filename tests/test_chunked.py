@@ -183,6 +183,11 @@ def test_grid_ignores_dead_bench_and_marks_parked(tmp_path, monkeypatch):
                         .astype(np.uint32)),
         rid=jnp.arange(n, dtype=jnp.uint32))
 
+    # warm the jit for these shapes so the timed region below measures the
+    # park behavior, not first-call compilation
+    from tpu_radix_join.ops.chunked import chunked_join_count
+    chunked_join_count(mk(9), mk(9), n)
+
     # 1) dead-PID pause file: grid must remove it and run immediately
     proc = subprocess.Popen(["true"])
     proc.wait()

@@ -29,4 +29,9 @@ run cli_16m_full 2400 python -m tpu_radix_join.main \
 run cli_16m_pipelined 2400 python -m tpu_radix_join.main \
     --tuples-per-node $SIXTEEN --nodes 1 --repeat 20 --pipeline-repeats \
     --output-dir "$OUT/perf_16m_pipelined"
+run trace_16m_twolevel 2400 python experiments/exp_trace_pipeline.py 24 \
+    "$OUT/trace_16m_twolevel" --two-level
+run cli_16m_full_pipelined 2400 python -m tpu_radix_join.main \
+    --tuples-per-node $SIXTEEN --nodes 1 --key-range full --repeat 20 \
+    --pipeline-repeats --output-dir "$OUT/perf_16m_full_pipelined"
 echo "ALL_EXTRA_CHIP_TASKS_DONE $(date -u +%H:%M:%S)"

@@ -235,13 +235,19 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
     import time as _time
 
     from tpu_radix_join.utils.locks import (
-        pid_file_alive, remove_pid_file, write_pid_file)
+        bench_pause_file, grid_presence_file, pid_file_alive,
+        remove_pid_file, write_pid_file)
 
-    pause_file = os.environ.get("TPU_RJ_PAUSE_FILE")
+    pause_file = bench_pause_file()
     # reciprocal presence file: bench.py drains the chip only when a live
-    # grid actually holds it (utils/locks.py)
-    grid_file = os.environ.get("TPU_RJ_GRID_FILE")
-    if grid_file and not write_pid_file(grid_file):
+    # grid actually holds it (utils/locks.py — ONE path definition for
+    # both sides of the handshake)
+    grid_file = grid_presence_file()
+    if write_pid_file(grid_file):
+        # a prior grid killed hard while parked leaves a stale .parked that
+        # would let the bench skip its drain while THIS run computes
+        remove_pid_file(grid_file + ".parked")
+    else:
         grid_file = None
 
     def yield_chip():

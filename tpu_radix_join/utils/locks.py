@@ -14,6 +14,24 @@ from __future__ import annotations
 import os
 from typing import Optional
 
+_ARTIFACTS = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "artifacts")
+
+
+def bench_pause_file() -> str:
+    """The bench's hold file — ONE definition for both sides of the
+    handshake (env ``TPU_RJ_PAUSE_FILE`` overrides the canonical path)."""
+    return os.environ.get("TPU_RJ_PAUSE_FILE",
+                          os.path.join(_ARTIFACTS, "BENCH_RUNNING"))
+
+
+def grid_presence_file() -> str:
+    """The grid's presence file (``+ ".parked"`` while yielded); env
+    ``TPU_RJ_GRID_FILE`` overrides the canonical path."""
+    return os.environ.get("TPU_RJ_GRID_FILE",
+                          os.path.join(_ARTIFACTS, "GRID_RUNNING"))
+
 
 def write_pid_file(path: str) -> bool:
     """Stamp ``path`` with this process's PID; False if unwritable."""
