@@ -117,8 +117,11 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
         except (OSError, ValueError):
             continue
         # host-plane artifacts (CPU smoke runs) sum nested Python frames,
-        # not device time — same refusal as measurements.py's CTOTAL guard
+        # not device time — same refusal as measurements.py's CTOTAL guard;
+        # non-sort disciplines (e.g. the two-level trace) carry a different
+        # program's sort time and are skipped (absent key = legacy sort)
         if (bd.get("size") == size and bd.get("sort_share")
+                and bd.get("discipline", "sort") == "sort"
                 and _is_device_plane(bd.get("plane", ""))):
             sort_s = bd["busy_us"] * bd["sort_share"] / bd["iters"] / 1e6
             src = os.path.relpath(path, here)

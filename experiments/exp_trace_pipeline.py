@@ -49,10 +49,10 @@ def main() -> int:
     # per-op table answers how its device time splits between the second
     # radix pass and the per-bucket probe (VERDICT r4 weak #3's "real work
     # vs round-trips" question, net of any dispatch entirely by design:
-    # the trace sees only device ops)
-    eng = HashJoin(JoinConfig(num_nodes=1, two_level=two_level,
-                              local_fanout_bits=5, allocation_factor=3.0)
-                   if two_level else JoinConfig(num_nodes=1))
+    # the trace sees only device ops).  Geometry stays at the JoinConfig
+    # defaults so the traced executable is the SAME program as the
+    # cli_16m_twolevel_fused timing run it explains.
+    eng = HashJoin(JoinConfig(num_nodes=1, two_level=two_level))
     r = eng.place(Relation(size, 1, "unique", seed=1))
     s = eng.place(Relation(size, 1, "unique", seed=2))
     cap_r, cap_s, _ = eng._measure_capacities(
@@ -88,6 +88,9 @@ def main() -> int:
 
     with open(os.path.join(out_dir, "breakdown.json"), "w") as f:
         json.dump({"size": size, "iters": ITERS, "plane": tr["plane"],
+                   # discipline marker: bench._sort_bandwidth_gbps must only
+                   # consume sort-path traces (absent key = legacy sort-path)
+                   "discipline": "two_level" if two_level else "sort",
                    "busy_us": busy, "sort_share": sort_us / busy,
                    "ops": tr["ops"]}, f, indent=1)
     print(f"wrote {out_dir}/breakdown.json", flush=True)

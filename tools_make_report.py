@@ -1,0 +1,103 @@
+"""Assemble the round's chip evidence into one summary table.
+
+    python tools_make_report.py [artifacts/chip_r5]
+
+Reads every perf dir (`<rank>.perf`/`<rank>.info`), trace breakdown
+(`trace_*/breakdown.json`), and task log under the artifact dir and prints a
+markdown summary (per-workload phase columns in ms/join net of repeats,
+JPROCRATE, CTOTAL where present, trace sort shares, runner task status).
+The output is the raw material for BASELINE.md's achieved tables — numbers
+come straight from the committed artifacts, no hand transcription.
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_radix_join.performance.measurements import Measurements
+
+PHASES = ("JHIST", "JMPI", "SLOCPREP", "JPROC", "BPBUILD", "BPPROBE",
+          "CTOTAL", "SDISPATCH")
+
+
+def perf_row(d):
+    ms = Measurements.load(d)
+    if not ms:
+        return None
+    m = ms[0]
+    repeat = 1
+    info_path = os.path.join(d, f"{m.node_id}.info")
+    meta = {}
+    if os.path.exists(info_path):
+        with open(info_path) as f:
+            meta = json.load(f)
+        repeat = int((meta.get("config") or {}).get("repeat") or 1)
+    row = {"dir": os.path.basename(d), "repeat": repeat,
+           "key_range": meta.get("key_range", "")}
+    for tag in PHASES:
+        if tag in m.times_us:
+            per_join = m.times_us[tag] / (repeat if tag != "SDISPATCH" else 1)
+            row[tag] = per_join / 1e3
+    if "JPROCRATE" in m.counters:
+        row["JPROCRATE_M/s"] = m.counters["JPROCRATE"] / 1e6
+    if "RESULTS" in m.counters:
+        # raw registry value: the driver stores the single-join count for
+        # synchronous repeats, the cumulative for pipelined mode — dividing
+        # here would guess wrong for one of them
+        row["RESULTS"] = m.counters["RESULTS"]
+    return row
+
+
+def main() -> int:
+    base = sys.argv[1] if len(sys.argv) > 1 else "artifacts/chip_r5"
+    print(f"# Evidence summary: {base}\n")
+
+    print("## Task status\n")
+    logs = sorted(glob.glob(os.path.join(base, "*.log")))
+    names = sorted({os.path.basename(p).split(".a")[0].removesuffix(".log")
+                    for p in logs})
+    for name in names:
+        done = os.path.exists(os.path.join(base, f"{name}.done"))
+        attempts = len(glob.glob(os.path.join(base, f"{name}.a*.log")))
+        print(f"- {name}: {'DONE' if done else 'pending'}"
+              f" ({attempts} attempt{'s' if attempts != 1 else ''})")
+
+    rows = [r for r in (perf_row(d) for d in sorted(
+        glob.glob(os.path.join(base, "perf_*")))) if r]
+    if rows:
+        keys = ["dir", "repeat", "key_range"] + [
+            k for k in (*PHASES, "JPROCRATE_M/s", "RESULTS")
+            if any(k in r for r in rows)]
+        print("\n## Perf artifacts (ms/join; SDISPATCH = floor per program)\n")
+        print("| " + " | ".join(keys) + " |")
+        print("|" + "---|" * len(keys))
+        for r in rows:
+            cells = []
+            for k in keys:
+                v = r.get(k, "")
+                cells.append(f"{v:.1f}" if isinstance(v, float) else str(v))
+            print("| " + " | ".join(cells) + " |")
+
+    traces = sorted(glob.glob(os.path.join(base, "trace_*",
+                                           "breakdown.json")))
+    if traces:
+        print("\n## Trace breakdowns\n")
+        for path in traces:
+            with open(path) as f:
+                bd = json.load(f)
+            per_iter = bd["busy_us"] / bd["iters"] / 1e3
+            print(f"- {os.path.relpath(path, base)}: plane `{bd['plane']}`, "
+                  f"{per_iter:.1f} ms/iter device-busy, "
+                  f"sort share {100 * bd['sort_share']:.1f}%")
+            top = sorted(bd["ops"].items(), key=lambda kv: -kv[1]["us"])[:5]
+            for name, v in top:
+                print(f"    - {v['us'] / bd['iters'] / 1e3:8.2f} ms/iter  "
+                      f"{name[:80]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
