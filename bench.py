@@ -141,11 +141,18 @@ def main():
     import atexit
 
     from tpu_radix_join.utils.locks import (
-        bench_pause_file, grid_presence_file, pid_file_alive,
-        remove_pid_file, write_pid_file)
+        acquire_pid_file, bench_pause_file, grid_presence_file,
+        pid_file_alive, remove_pid_file)
     pause_file = bench_pause_file()
-    write_pid_file(pause_file)
-    atexit.register(remove_pid_file, pause_file)
+    # atomic acquisition: a concurrent live bench (the runner's task racing
+    # the driver's official capture) makes us wait; two simultaneous starts
+    # cannot both win the O_EXCL create
+    if acquire_pid_file(pause_file, timeout_s=900, poll_s=15):
+        atexit.register(remove_pid_file, pause_file)
+    else:
+        print("WARNING: another live bench still holds the chip after the "
+              "wait deadline — timings below may be contaminated",
+              file=sys.stderr)
     grid_file = grid_presence_file()
 
     def _grid_busy():

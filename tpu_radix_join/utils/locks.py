@@ -51,6 +51,39 @@ def remove_pid_file(path: str) -> None:
         pass
 
 
+def acquire_pid_file(path: str, timeout_s: float,
+                     poll_s: float = 5.0) -> bool:
+    """Atomically acquire a PID-stamped hold file.
+
+    ``O_CREAT|O_EXCL`` closes the check-then-write race two concurrent
+    acquirers would otherwise hit; a file whose stamped holder is dead is
+    broken and re-contested immediately.  True on acquisition; False when a
+    LIVE holder still owns the file at the deadline (the caller must then
+    proceed without the reservation — never overwrite a live holder's
+    stamp, whose atexit would delete the file out from under us)."""
+    import time
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+    except OSError:
+        return False
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.write(fd, str(os.getpid()).encode())
+            os.close(fd)
+            return True
+        except FileExistsError:
+            if pid_file_alive(path) is False:
+                remove_pid_file(path)   # dead holder: break and re-contest
+                continue
+            if time.monotonic() >= deadline:
+                return False
+            time.sleep(poll_s)
+        except OSError:
+            return False
+
+
 def pid_file_alive(path: str) -> Optional[bool]:
     """Is the process that stamped ``path`` still alive?
 
