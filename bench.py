@@ -147,11 +147,27 @@ def main():
     # atomic acquisition: a concurrent live bench (the runner's task racing
     # the driver's official capture) makes us wait; two simultaneous starts
     # cannot both win the O_EXCL create
-    if acquire_pid_file(pause_file, timeout_s=900, poll_s=15):
+    status = acquire_pid_file(pause_file, timeout_s=900, poll_s=15)
+    if status == "acquired":
         atexit.register(remove_pid_file, pause_file)
-    else:
+    elif status == "busy":
         print("WARNING: another live bench still holds the chip after the "
               "wait deadline — timings below may be contaminated",
+              file=sys.stderr)
+        # keep contending in the background: the moment the peer exits we
+        # stamp the reservation, so the grid stays parked for the rest of
+        # this run instead of unparking mid-timed-window
+        import threading
+
+        def _contend():
+            if acquire_pid_file(pause_file, timeout_s=86400,
+                                poll_s=15) == "acquired":
+                atexit.register(remove_pid_file, pause_file)
+
+        threading.Thread(target=_contend, daemon=True).start()
+    else:
+        print(f"WARNING: could not stamp the chip reservation file "
+              f"({pause_file} unwritable); grid runs will not park",
               file=sys.stderr)
     grid_file = grid_presence_file()
 

@@ -1,0 +1,74 @@
+"""utils/locks.py: the PID-stamped chip-reservation protocol between
+bench.py and the out-of-core grid (single shared device)."""
+
+import os
+import subprocess
+import threading
+import time
+
+from tpu_radix_join.utils.locks import (
+    acquire_pid_file, pid_file_alive, remove_pid_file, write_pid_file)
+
+
+def _dead_pid():
+    p = subprocess.Popen(["true"])
+    p.wait()
+    return p.pid
+
+
+def test_write_and_liveness(tmp_path):
+    p = str(tmp_path / "lock")
+    assert write_pid_file(p)
+    assert pid_file_alive(p) is True          # our own pid
+    open(p, "w").write(str(_dead_pid()))
+    assert pid_file_alive(p) is False
+    open(p, "w").write("")                    # PID-less
+    assert pid_file_alive(p) is None
+    remove_pid_file(p)
+    assert pid_file_alive(p) is None          # missing
+
+
+def test_acquire_paths(tmp_path):
+    p = str(tmp_path / "lock")
+    assert acquire_pid_file(p, 1) == "acquired"
+    assert open(p).read() == str(os.getpid())
+    # live holder (ourselves): busy at deadline, stamp untouched
+    assert acquire_pid_file(p, 0.3, poll_s=0.1) == "busy"
+    assert open(p).read() == str(os.getpid())
+    # dead holder: broken immediately, well under the deadline
+    open(p, "w").write(str(_dead_pid()))
+    t0 = time.monotonic()
+    assert acquire_pid_file(p, 5, poll_s=0.1) == "acquired"
+    assert time.monotonic() - t0 < 1.0
+    # PID-less holder: given two polls, then broken
+    open(p, "w").write("")
+    assert acquire_pid_file(p, 5, poll_s=0.05) == "acquired"
+    remove_pid_file(p)
+
+
+def test_acquire_unwritable_is_error_not_busy(tmp_path):
+    # parent "directory" is a regular file -> unconditionally unwritable,
+    # even for root (chmod-based denial doesn't bind uid 0)
+    blocker = tmp_path / "blocker"
+    blocker.write_text("")
+    assert acquire_pid_file(str(blocker / "lock"), 0.2) == "error"
+
+
+def test_acquire_contention_single_winner(tmp_path):
+    p = str(tmp_path / "lock")
+    results = []
+    barrier = threading.Barrier(8)
+
+    def contend():
+        barrier.wait()
+        results.append(acquire_pid_file(p, 0.5, poll_s=0.05))
+
+    ts = [threading.Thread(target=contend) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    # same-process contenders: one wins, the rest see a live holder
+    assert results.count("acquired") == 1, results
+    assert results.count("busy") == 7, results
+    assert not [f for f in os.listdir(tmp_path) if ".stale." in f]

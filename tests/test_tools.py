@@ -1,0 +1,43 @@
+"""Regression tests for the artifact-analysis tools: the evidence-summary
+generator (tools_make_report.py) and the net-of-dispatch phase table
+(experiments/exp_phase_net.py) parse the committed round-3 chip artifacts
+to known values, so a refactor of the perf format or the tools cannot
+silently corrupt the numbers BASELINE.md quotes."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+R3 = os.path.join(REPO, "artifacts", "chip_r3")
+
+
+def _run(*argv):
+    out = subprocess.run([sys.executable, *argv], capture_output=True,
+                         text=True, cwd=REPO, timeout=120)
+    assert out.returncode == 0, out.stderr
+    return out.stdout
+
+
+def test_make_report_reproduces_r3_numbers():
+    out = _run("tools_make_report.py", R3)
+    # the committed BASELINE.md round-3 table, straight from the artifacts
+    assert "| perf_16m_sort_devgen | 3 |  |  |  |  | 108.5 | 309.4 |" in out
+    assert "| perf_20m_phases_devgen | 3 |  | 83.2 | 317.1 | 366.3 | 507.4 " \
+           "| 78.8 |" in out
+    assert "## Task status" in out
+
+
+def test_make_report_empty_dir(tmp_path):
+    out = _run("tools_make_report.py", str(tmp_path))
+    assert "Evidence summary" in out      # no artifacts -> no tables, no crash
+
+
+def test_phase_net_r3_table():
+    out = _run("experiments/exp_phase_net.py",
+               os.path.join(R3, "perf_16m_phases_devgen"),
+               os.path.join(R3, "perf_16m_sort_devgen"))
+    # r3 artifacts predate SDISPATCH: net == gross, flagged loudly
+    assert "no SDISPATCH tag" in out
+    assert "JPROC" in out and "fused dir" in out
+    assert "JPROC gross 108.5 ms/join" in out

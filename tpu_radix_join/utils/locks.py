@@ -52,36 +52,74 @@ def remove_pid_file(path: str) -> None:
 
 
 def acquire_pid_file(path: str, timeout_s: float,
-                     poll_s: float = 5.0) -> bool:
+                     poll_s: float = 5.0) -> str:
     """Atomically acquire a PID-stamped hold file.
 
-    ``O_CREAT|O_EXCL`` closes the check-then-write race two concurrent
-    acquirers would otherwise hit; a file whose stamped holder is dead is
-    broken and re-contested immediately.  True on acquisition; False when a
-    LIVE holder still owns the file at the deadline (the caller must then
-    proceed without the reservation — never overwrite a live holder's
-    stamp, whose atexit would delete the file out from under us)."""
+    Returns ``"acquired"``, ``"busy"`` (a LIVE holder still owns the file
+    at the deadline — never overwritten: its atexit would delete the file
+    out from under us), or ``"error"`` (the path is unwritable — distinct
+    from busy so callers don't misdiagnose a permissions problem as a
+    phantom contender).
+
+    Races closed: ``O_CREAT|O_EXCL`` decides simultaneous creates; a dead
+    or PID-less holder's file is broken by an atomic RENAME to a
+    contender-private name — exactly one contender gets it — and the
+    renamed file is re-verified before discard, so a live file recreated
+    in the check window is restored, not destroyed.  A write failure after
+    the create unlinks the empty stamp instead of leaving an unbreakable
+    PID-less file."""
     import time
     try:
         os.makedirs(os.path.dirname(path), exist_ok=True)
     except OSError:
-        return False
+        return "error"
     deadline = time.monotonic() + timeout_s
+    nones = 0   # consecutive PID-less sightings (transient create window)
     while True:
         try:
             fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-            os.write(fd, str(os.getpid()).encode())
+            try:
+                os.write(fd, str(os.getpid()).encode())
+            except OSError:
+                os.close(fd)
+                remove_pid_file(path)
+                return "error"
             os.close(fd)
-            return True
+            return "acquired"
         except FileExistsError:
-            if pid_file_alive(path) is False:
-                remove_pid_file(path)   # dead holder: break and re-contest
+            alive = pid_file_alive(path)
+            if alive is True:
+                nones = 0
+                if time.monotonic() >= deadline:
+                    return "busy"
+                time.sleep(poll_s)
                 continue
-            if time.monotonic() >= deadline:
-                return False
-            time.sleep(poll_s)
+            if alive is None:
+                # missing (re-contest now) or PID-less: give a holder
+                # mid-create two polls before treating the file as broken
+                nones += 1
+                if not os.path.exists(path):
+                    continue
+                if nones <= 2:
+                    time.sleep(poll_s)
+                    continue
+            nones = 0
+            stale = f"{path}.stale.{os.getpid()}"
+            try:
+                os.rename(path, stale)
+            except OSError:
+                continue            # another contender broke it first
+            if pid_file_alive(stale) is True:
+                # we grabbed a file recreated by a live winner inside the
+                # check window: put it back (best effort) and keep waiting
+                try:
+                    os.rename(stale, path)
+                except OSError:
+                    remove_pid_file(stale)
+                continue
+            remove_pid_file(stale)  # confirmed dead/broken; re-contest
         except OSError:
-            return False
+            return "error"
 
 
 def pid_file_alive(path: str) -> Optional[bool]:
