@@ -119,3 +119,19 @@ def test_cli_pipeline_repeats_rejects_measure_phases():
     with pytest.raises(SystemExit):
         main(["--tuples-per-node", "1024", "--repeat", "3",
               "--pipeline-repeats", "--measure-phases"])
+
+
+def test_cli_trace_composes_with_measure_phases(tmp_path):
+    """--trace + --measure-phases: the profiler bracket must span the split
+    programs and still land the per-op table (the reference's PAPI bracket
+    wraps its phased join the same way, Measurements.cpp:90-141)."""
+    import json
+
+    out_dir = tmp_path / "exp"
+    rc = main(["--tuples-per-node", "2048", "--nodes", "4",
+               "--measure-phases", "--trace", "--output-dir", str(out_dir)])
+    assert rc == 0
+    info = json.loads((out_dir / "0.info").read_text())
+    assert "trace" in info and info["trace"]["ops"]
+    perf = (out_dir / "0.perf").read_text()
+    assert "JMPI" in perf and "JPROC" in perf     # split columns intact
