@@ -242,3 +242,25 @@ def test_merge_full_inside_shard_map():
     assert int(np.asarray(mw)) == 1
     with pytest.raises(ValueError, match="varying manual axes"):
         body("pallas_interpret")(jnp.asarray(r), jnp.asarray(s))
+
+
+def test_key_boundary_values_exact():
+    """Boundary keys around the packing cap and the sentinel floor: every
+    sub-sentinel value joins exactly on the full path; the narrow path is
+    exact up to MAX_MERGE_KEY inclusive."""
+    from tpu_radix_join.ops.merge_count import merge_count_chunks
+
+    edge = np.array([0, 1, MAX_MERGE_KEY - 1, MAX_MERGE_KEY,
+                     MAX_MERGE_KEY + 1, 1 << 31, 0xFFFFFFFC, 0xFFFFFFFD],
+                    dtype=np.uint32)
+    pad = np.arange(100, 100 + 120, dtype=np.uint32)     # fill to size
+    keys = np.concatenate([edge, pad])
+    # full path: every key matches itself exactly once, in its partition
+    c = merge_count_per_partition_full(
+        jnp.asarray(keys), jnp.asarray(keys), 3)
+    np.testing.assert_array_equal(
+        np.asarray(c).astype(np.uint64), _oracle_counts(keys, keys, 3))
+    # narrow path on the in-contract prefix only
+    ok = keys[keys <= MAX_MERGE_KEY]
+    cn = merge_count_chunks(jnp.asarray(ok), jnp.asarray(ok))
+    assert int(np.asarray(cn).astype(np.uint64).sum()) == ok.size

@@ -8,7 +8,7 @@ import pytest
 from tpu_radix_join import HashJoin, JoinConfig, Relation
 from tpu_radix_join.data.relation import host_join_count
 
-CASES = list(range(14))
+CASES = list(range(20))
 
 
 def _random_case(case: int):
