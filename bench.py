@@ -42,6 +42,10 @@ def _time_amortized(fn, args, iters=20):
     return (time.perf_counter() - t0) / iters
 
 
+class _BackendDown(ConnectionError):
+    """One backend probe failed (tunnel down / device init error)."""
+
+
 def _wait_for_backend():
     """Probe the device backend, retrying a downed tunnel for up to
     BENCH_TUNNEL_WAIT_SEC (default 20 min) before giving up.
@@ -51,39 +55,52 @@ def _wait_for_backend():
     with a hard per-attempt timeout.  Two rounds of BENCH_r0*.json rc=2
     showed a one-shot 120s window loses against tunnel flakiness, so the
     bench now rides out transient outages itself instead of leaving the
-    round's official capture empty.
+    round's official capture empty.  The retry loop is a
+    robustness.retry.RetryPolicy whose ``max_elapsed_s`` is the budget —
+    the same deadline discipline the rest of the resilience layer uses.
     """
     import subprocess
+
+    from tpu_radix_join.robustness.retry import (RetriesExhausted,
+                                                 RetryPolicy, execute)
+
     budget = float(os.environ.get("BENCH_TUNNEL_WAIT_SEC", "1200"))
-    deadline = time.monotonic() + budget
-    attempt = 0
-    while True:
-        attempt += 1
+    attempts = [0]
+
+    def probe():
+        attempts[0] += 1
         try:
             # sitecustomize locks the platform default at import; the child
             # re-applies any JAX_PLATFORMS override the same way the parent
-            probe = subprocess.run(
+            p = subprocess.run(
                 [sys.executable, "-c",
                  "import os, jax\n"
                  "p = os.environ.get('JAX_PLATFORMS')\n"
                  "p and jax.config.update('jax_platforms', p)\n"
                  "print(jax.devices()[0])"],
                 capture_output=True, text=True, timeout=120)
-            if probe.returncode == 0:
-                print(f"note: device: {probe.stdout.strip()} "
-                      f"(probe attempt {attempt})", file=sys.stderr)
-                return
-            err = (probe.stderr.strip().splitlines() or ["?"])[-1]
         except subprocess.TimeoutExpired:
-            err = "probe hung 120s (tunnel down)"
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            print(f"ERROR: device backend unavailable after {attempt} probes "
-                  f"over {budget:.0f}s: {err}", file=sys.stderr)
-            sys.exit(2)
-        print(f"note: backend probe {attempt} failed ({err}); "
-              f"{remaining:.0f}s of wait budget left", file=sys.stderr)
-        time.sleep(min(60.0, max(1.0, remaining)))
+            raise _BackendDown("probe hung 120s (tunnel down)")
+        if p.returncode != 0:
+            raise _BackendDown((p.stderr.strip().splitlines() or ["?"])[-1])
+        print(f"note: device: {p.stdout.strip()} "
+              f"(probe attempt {attempts[0]})", file=sys.stderr)
+
+    def on_retry(attempt, err, delay):
+        print(f"note: backend probe {attempt + 1} failed ({err}); "
+              f"retrying in {delay:.0f}s", file=sys.stderr)
+
+    # attempts effectively unbounded; the elapsed budget is the terminator
+    policy = RetryPolicy(max_attempts=1 << 20, base_delay_s=15.0,
+                         multiplier=1.5, max_delay_s=60.0, jitter=0.1,
+                         max_elapsed_s=budget)
+    try:
+        execute(probe, policy, retryable=(_BackendDown,),
+                on_retry=on_retry, label="backend_probe")
+    except RetriesExhausted as e:
+        print(f"ERROR: device backend unavailable after {e.attempts} probes "
+              f"over {budget:.0f}s: {e.last_error}", file=sys.stderr)
+        sys.exit(2)
 
 
 def _sort_bandwidth_gbps(probe_dt_s, size):
@@ -159,10 +176,25 @@ def main():
         # this run instead of unparking mid-timed-window
         import threading
 
+        # Shutdown handshake: a daemon thread dies unjoined at interpreter
+        # exit, so an acquisition that lands while the process is tearing
+        # down would leak a stamp no atexit can clean — the grid would stay
+        # parked until its stale-PID sweep.  The main thread sets this event
+        # at exit; the contender re-checks it around every acquisition and
+        # releases immediately when it lost the race.
+        bench_done = threading.Event()
+        atexit.register(bench_done.set)
+
         def _contend():
-            if acquire_pid_file(pause_file, timeout_s=86400,
-                                poll_s=15) == "acquired":
-                atexit.register(remove_pid_file, pause_file)
+            while not bench_done.is_set():
+                if acquire_pid_file(pause_file, timeout_s=60,
+                                    poll_s=15) != "acquired":
+                    continue
+                if bench_done.is_set():
+                    remove_pid_file(pause_file)
+                else:
+                    atexit.register(remove_pid_file, pause_file)
+                return
 
         threading.Thread(target=_contend, daemon=True).start()
     else:

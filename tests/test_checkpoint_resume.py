@@ -1,0 +1,166 @@
+"""Tier-1 coverage for checkpoint/resume: a killed out-of-core grid join
+must resume from its last completed chunk pair with the exact total and
+zero recomputed slabs (acceptance criterion), and the CheckpointManager's
+atomicity/fingerprint/corruption rules must hold."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.chunked import chunked_join_grid
+from tpu_radix_join.performance.measurements import (CKPTLOAD, CKPTSAVE,
+                                                     GRIDPAIRS, Measurements,
+                                                     RETRYN)
+from tpu_radix_join.robustness import faults
+from tpu_radix_join.robustness.checkpoint import (CheckpointManager,
+                                                  CheckpointMismatch)
+from tpu_radix_join.robustness.faults import (FaultInjector, InjectedKill,
+                                              TransientFault)
+from tpu_radix_join.robustness.retry import RetryPolicy
+
+
+def _quarters(seed, n=1 << 12):
+    rel = Relation(n, 1, "unique", seed=seed)
+    b = rel.shard(0)
+    k, r = np.asarray(b.key), np.asarray(b.rid)
+    q = n // 4
+    return [TupleBatch(key=jnp.asarray(k[i * q:(i + 1) * q]),
+                       rid=jnp.asarray(r[i * q:(i + 1) * q]))
+            for i in range(4)]
+
+
+def test_kill_and_resume_exact_zero_recompute(tmp_path):
+    """Kill mid-grid after 2 of 16 pairs; the resumed run must reach the
+    exact oracle total with CKPTLOAD >= 1 and GRIDPAIRS == 14 — completed
+    pairs are never re-probed."""
+    r_chunks, s_chunks = _quarters(1), _quarters(1)   # same keys: 4096 matches
+    ckpt = str(tmp_path / "grid.ckpt")
+
+    m1 = Measurements()
+    with FaultInjector() as inj:
+        inj.arm(faults.GRID_KILL, at=3, exc=InjectedKill)
+        with pytest.raises(InjectedKill):
+            chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              measurements=m1)
+    assert m1.counters[GRIDPAIRS] == 2
+    assert m1.counters[CKPTSAVE] == 2
+    state = json.load(open(ckpt))
+    assert (state["i"], state["j"]) == (0, 2) and not state["done"]
+
+    m2 = Measurements()
+    total = chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              measurements=m2)
+    assert total == 1 << 12
+    assert m2.counters[CKPTLOAD] >= 1
+    assert m2.counters[GRIDPAIRS] == 14   # zero recompute
+    assert json.load(open(ckpt))["done"]
+
+    # a third run short-circuits on the done marker: no pairs probed at all
+    m3 = Measurements()
+    assert chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                             checkpoint_path=ckpt, checkpoint_tag="t",
+                             measurements=m3) == 1 << 12
+    assert GRIDPAIRS not in m3.counters
+
+
+def test_grid_transient_retry(tmp_path):
+    """An armed per-pair transient costs one backoff, not the run."""
+    r_chunks, s_chunks = _quarters(2), _quarters(2)
+    m = Measurements()
+    with FaultInjector() as inj:
+        inj.arm(faults.GRID_TRANSIENT, times=1, exc=TransientFault)
+        total = chunked_join_grid(
+            r_chunks, s_chunks, 1 << 10, measurements=m,
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0))
+    assert total == 1 << 12
+    assert inj.fired(faults.GRID_TRANSIENT) == 1
+    assert m.counters[RETRYN] == 1
+    assert m.counters[GRIDPAIRS] == 16
+
+
+# --------------------------------------------------------- CheckpointManager
+
+def test_checkpoint_roundtrip_and_done(tmp_path):
+    m = Measurements()
+    ck = CheckpointManager(str(tmp_path / "c.json"), {"slab": 8, "tag": "x"},
+                           measurements=m)
+    assert ck.load() is None               # missing file: fresh start
+    assert ck.save({"i": 1, "j": 2, "total": 99})
+    state = ck.load()
+    assert state == {"i": 1, "j": 2, "total": 99, "done": False}
+    assert ck.save({"i": 4, "j": 0, "total": 123}, done=True)
+    assert ck.load()["done"]
+    assert m.counters[CKPTSAVE] == 2 and m.counters[CKPTLOAD] == 2
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+
+def test_checkpoint_fingerprint_mismatch(tmp_path):
+    path = str(tmp_path / "c.json")
+    CheckpointManager(path, {"slab": 8}).save({"total": 1})
+    with pytest.raises(CheckpointMismatch):
+        CheckpointManager(path, {"slab": 16}).load()
+
+
+def test_checkpoint_corrupt_restarts(tmp_path):
+    path = tmp_path / "c.json"
+    path.write_text('{"truncated": ')
+    m = Measurements()
+    assert CheckpointManager(str(path), {"slab": 8}, m).load() is None
+    assert any(e["event"] == "checkpoint_corrupt" for e in m.meta["events"])
+    path.write_text('{"no_fingerprint_key": 1}')
+    assert CheckpointManager(str(path), {"slab": 8}, m).load() is None
+
+
+def test_checkpoint_save_failure_does_not_kill_grid(tmp_path):
+    """Durability beats availability: every save failing (injected OSError)
+    must cost resume points, not the join."""
+    r_chunks, s_chunks = _quarters(3), _quarters(3)
+    m = Measurements()
+    with FaultInjector() as inj:
+        inj.arm(faults.CKPT_SAVE, p=1.0, exc=OSError)
+        total = chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                                  checkpoint_path=str(tmp_path / "g.ckpt"),
+                                  checkpoint_tag="t", measurements=m)
+    assert total == 1 << 12
+    assert CKPTSAVE not in m.counters
+    assert any(e["event"] == "checkpoint_save_failed"
+               for e in m.meta["events"])
+
+
+def test_checkpoint_load_fault_restarts(tmp_path):
+    path = str(tmp_path / "c.json")
+    CheckpointManager(path, {"slab": 8}).save({"total": 7})
+    with FaultInjector() as inj:
+        inj.arm(faults.CKPT_LOAD, p=1.0, exc=OSError)
+        assert CheckpointManager(path, {"slab": 8}).load() is None
+    assert CheckpointManager(path, {"slab": 8}).load()["total"] == 7
+
+
+# ------------------------------------------------------------------ main CLI
+
+def test_main_grid_cli_checkpoint_and_resume(tmp_path, capsys):
+    from tpu_radix_join.main import main
+
+    argv = ["--nodes", "1", "--tuples-per-node", "4096",
+            "--grid-chunk-tuples", "2048",
+            "--checkpoint-dir", str(tmp_path)]
+    assert main(argv) == 0
+    out = capsys.readouterr().out
+    assert "[RESULTS] Expected: 4096 (OK)" in out
+    ckpt = tmp_path / "grid.ckpt"
+    assert json.loads(ckpt.read_text())["done"]
+
+    # --resume on a done checkpoint returns the stored total without
+    # probing; without --resume the stale file is removed and re-created
+    assert main(argv + ["--resume"]) == 0
+    assert "Expected: 4096 (OK)" in capsys.readouterr().out
+    assert main(argv) == 0
+    assert json.loads(ckpt.read_text())["done"]

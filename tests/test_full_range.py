@@ -240,8 +240,12 @@ def test_merge_full_inside_shard_map():
         for i in range(n_dev))
     assert total == want, (total, want)
     assert int(np.asarray(mw)) == 1
-    with pytest.raises(ValueError, match="varying manual axes"):
-        body("pallas_interpret")(jnp.asarray(r), jnp.asarray(s))
+    from tpu_radix_join.utils import compat
+    if not compat.is_legacy():
+        # the "varying manual axes" rejection is a current-jax vma check;
+        # the legacy shard_map (check_rep=False shim) predates it
+        with pytest.raises(ValueError, match="varying manual axes"):
+            body("pallas_interpret")(jnp.asarray(r), jnp.asarray(s))
 
 
 def test_key_boundary_values_exact():

@@ -35,12 +35,19 @@ def perf_row(d):
         with open(info_path) as f:
             meta = json.load(f)
         repeat = int((meta.get("config") or {}).get("repeat") or 1)
+    pipelined = bool((meta.get("config") or {}).get("pipeline_repeats"))
     row = {"dir": os.path.basename(d), "repeat": repeat,
+           "pipelined": "y" if pipelined else "",
            "key_range": meta.get("key_range", "")}
+    # Once-per-invocation tags: in --pipeline-repeats runs the sizing
+    # pre-pass (JHIST) executes once for the whole batch of dispatches, so
+    # dividing it by repeat would report a per-join cost no join pays;
+    # synchronous repeats re-run it per join, where dividing is right.
+    once_per_call = ("JHIST",) if pipelined else ()
     for tag in PHASES:
         if tag in m.times_us:
-            per_join = m.times_us[tag] / (repeat if tag != "SDISPATCH" else 1)
-            row[tag] = per_join / 1e3
+            div = 1 if (tag == "SDISPATCH" or tag in once_per_call) else repeat
+            row[tag] = m.times_us[tag] / div / 1e3
     if "JPROCRATE" in m.counters:
         row["JPROCRATE_M/s"] = m.counters["JPROCRATE"] / 1e6
     if "RESULTS" in m.counters:
@@ -68,7 +75,11 @@ def main() -> int:
     rows = [r for r in (perf_row(d) for d in sorted(
         glob.glob(os.path.join(base, "perf_*")))) if r]
     if rows:
-        keys = ["dir", "repeat", "key_range"] + [
+        # the pipelined column only appears when some run used it, so
+        # tables over legacy artifacts keep their committed shape
+        keys = ["dir", "repeat"] + (
+            ["pipelined"] if any(r["pipelined"] for r in rows) else []
+        ) + ["key_range"] + [
             k for k in (*PHASES, "JPROCRATE_M/s", "RESULTS")
             if any(k in r for r in rows)]
         print("\n## Perf artifacts (ms/join; SDISPATCH = floor per program)\n")

@@ -14,8 +14,14 @@ cd /root/repo
 OUT=artifacts/chip_r5
 source tools_chip_lib.sh
 
-# $ must not match this script's own cmdline ("..._extra.sh 19533")
-while pgrep -f 'bash tools_run_chip_tasks\.sh$' >/dev/null; do
+# Match the primary runner by SCRIPT NAME, not by invocation form: the old
+# 'bash tools_run_chip_tasks.sh$' pattern let './tools_run_chip_tasks.sh',
+# 'bash /root/repo/tools_run_chip_tasks.sh', or any trailing argument slip
+# past the guard and time benchmarks concurrently through the one chip.
+# This script's own cmdline never matches ("..._tasks_extra.sh" puts
+# '_extra' where the pattern requires '.sh'), and our own PID is excluded
+# anyway in case a caller ever embeds the primary's name in our argv.
+while pgrep -f 'tools_run_chip_tasks\.sh' | grep -qvw "$$"; do
   sleep 60
 done
 

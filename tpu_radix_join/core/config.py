@@ -89,6 +89,22 @@ class JoinConfig:
                                              # static shapes (0 = detect only, the
                                              # reference's abort-on-failure parity)
 
+    # --- resilience (robustness/) ----------------------------------------------
+    # Terminal behavior once max_retries capacity doublings are exhausted:
+    #   "none"    — return ok=False with diagnostics (detect-and-report).
+    #   "chunked" — degrade to the out-of-core chunked count (ops/chunked.py),
+    #               whose only capacity is the caller-chosen slab size; the
+    #               result carries diagnostics["degraded"] = "chunked".
+    fallback: str = "none"
+    # Pause between capacity-grow retry attempts (0 = immediate, the
+    # pre-robustness behavior).  Exponential with deterministic jitter
+    # (robustness/retry.RetryPolicy): attempt k sleeps
+    # min(retry_backoff_s * retry_backoff_mult**k, retry_backoff_max_s).
+    retry_backoff_s: float = 0.0
+    retry_backoff_mult: float = 2.0
+    retry_backoff_max_s: float = 30.0
+    retry_jitter: float = 0.0
+
     # --- skew handling ---------------------------------------------------------
     # Probe-level hot-partition splitting (operators/skew.py; the reference's
     # dormant SD::OPT skew machinery, kernels_optimized.cu:301-344,864-943):
@@ -141,6 +157,14 @@ class JoinConfig:
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
+        if self.fallback not in ("none", "chunked"):
+            raise ValueError(f"unknown fallback mode {self.fallback!r}")
+        if self.retry_backoff_s < 0 or self.retry_backoff_max_s < 0:
+            raise ValueError("retry backoff delays must be >= 0")
+        if self.retry_backoff_mult < 1.0:
+            raise ValueError("retry_backoff_mult must be >= 1.0")
+        if not 0.0 <= self.retry_jitter <= 1.0:
+            raise ValueError("retry_jitter must be in [0, 1]")
         if self.generation not in ("auto", "host", "device"):
             raise ValueError(f"unknown generation mode {self.generation!r}")
         if self.key_range not in ("auto", "narrow", "full"):

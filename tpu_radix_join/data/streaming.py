@@ -33,6 +33,18 @@ import numpy as np
 from tpu_radix_join.data.relation import Relation, device_range, key_hi_lane
 from tpu_radix_join.data.tuples import TupleBatch
 from tpu_radix_join.memory.pool import Pool
+from tpu_radix_join.robustness import faults as _faults
+
+
+def _maybe_corrupt(key: jnp.ndarray) -> jnp.ndarray:
+    """Fault site ``stream.corrupt_lane``: when armed, smash the chunk's
+    first key to the reserved sentinel 0xFFFFFFFF — the damage a flipped
+    bit or torn read would do.  Downstream key-contract checks (chunked
+    auto-range probe, engine key-width guard) must detect it loudly; the
+    site exists so tier-1 can prove they do."""
+    if _faults.fires(_faults.STREAM_CORRUPT):
+        key = key.at[0].set(jnp.uint32(0xFFFFFFFF))
+    return key
 
 
 def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
@@ -85,7 +97,7 @@ def stream_chunks(rel: Relation, node: int, chunk_tuples: int,
             # two uint32 buffers regardless of key width
             hi = key_hi_lane(key) if rel.key_bits == 64 else None
             jax.block_until_ready((key, rid))
-            yield TupleBatch(key=key, rid=rid, key_hi=hi)
+            yield TupleBatch(key=_maybe_corrupt(key), rid=rid, key_hi=hi)
     finally:
         ex.shutdown(wait=True)
         if own_pool:
@@ -119,7 +131,7 @@ def stream_chunks_device(rel: Relation, node: int,
                                wide)
         if wide:
             key, hi, rid = out
-            yield TupleBatch(key=key, rid=rid, key_hi=hi)
+            yield TupleBatch(key=_maybe_corrupt(key), rid=rid, key_hi=hi)
         else:
             key, rid = out
-            yield TupleBatch(key=key, rid=rid, key_hi=None)
+            yield TupleBatch(key=_maybe_corrupt(key), rid=rid, key_hi=None)

@@ -44,6 +44,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "(out-of-core LD mode)")
     p.add_argument("--max-retries", type=int, default=0,
                    help="capacity-shortfall retries with doubled shapes")
+    p.add_argument("--retry-backoff", type=float, default=0.0,
+                   help="seconds to pause before the first capacity retry "
+                        "(doubles each attempt, robustness/retry.py); 0 = "
+                        "immediate")
+    p.add_argument("--fallback", choices=["none", "chunked"], default="none",
+                   help="after max-retries capacity doublings still "
+                        "overflow: 'chunked' degrades to the out-of-core "
+                        "count instead of returning ok=False")
+    p.add_argument("--cpu-fallback", action="store_true",
+                   help="if device/mesh init fails, rebuild the engine over "
+                        "host CPU devices (loud [DEGRADE] warning) instead "
+                        "of aborting")
+    p.add_argument("--grid-chunk-tuples", type=int, default=None,
+                   help="run the out-of-core grid join (ops/chunked.py) "
+                        "streaming both relations in chunks of this many "
+                        "tuples; single-node only")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="grid mode: directory for the slab-boundary "
+                        "checkpoint file (atomic save after every chunk "
+                        "pair; see --resume)")
+    p.add_argument("--resume", action="store_true",
+                   help="grid mode: resume from the checkpoint in "
+                        "--checkpoint-dir (default: a fresh run removes any "
+                        "stale checkpoint first)")
     p.add_argument("--skew-threshold", type=float, default=None,
                    help="split partitions heavier than this multiple of the "
                         "mean (replicate inner / spread outer); off by default")
@@ -95,6 +119,54 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _run_grid(args, inner, outer, expected, meas) -> int:
+    """Out-of-core grid mode: both relations streamed in device-generated
+    chunks, every (inner, outer) chunk pair probed exactly once, with an
+    atomic checkpoint after each pair (--checkpoint-dir) so a killed run
+    resumes from its last completed pair (--resume) instead of restarting
+    — the capability the single-shot reference lacks (SURVEY.md §5.4)."""
+    import os
+
+    from tpu_radix_join.data.streaming import stream_chunks_device
+    from tpu_radix_join.ops.chunked import chunked_join_grid
+    from tpu_radix_join.robustness.retry import RetryPolicy
+
+    chunk = args.grid_chunk_tuples
+    ckpt_path = None
+    if args.checkpoint_dir:
+        os.makedirs(args.checkpoint_dir, exist_ok=True)
+        ckpt_path = os.path.join(args.checkpoint_dir, "grid.ckpt")
+        if not args.resume and os.path.exists(ckpt_path):
+            # a fresh run must never silently resume from a stale file
+            os.remove(ckpt_path)
+    # fingerprint tag: everything that changes the grid's total
+    tag = (f"{args.outer_kind}:{inner.global_size}:{args.seed}:{chunk}:"
+           f"{args.key_range}")
+    policy = (RetryPolicy(max_attempts=args.max_retries + 1,
+                          base_delay_s=args.retry_backoff or 0.5,
+                          jitter=0.1)
+              if args.max_retries else None)
+    meas.start("JTOTAL")
+    total = chunked_join_grid(
+        stream_chunks_device(inner, 0, chunk),
+        lambda: stream_chunks_device(outer, 0, chunk),
+        min(chunk, 1 << 20),
+        checkpoint_path=ckpt_path, checkpoint_tag=tag,
+        progress=True, key_range=args.key_range, measurements=meas,
+        retry_policy=policy)
+    meas.stop("JTOTAL")
+    print(f"[RESULTS] Tuples: {total}")
+    if expected is not None:
+        status = "OK" if total == expected else "MISMATCH"
+        print(f"[RESULTS] Expected: {expected} ({status})")
+    for line in meas.lines():
+        print(f"[PERF] {line}")
+    if args.output_dir:
+        path = meas.store(args.output_dir)
+        print(f"[PERF] stored {path}")
+    return 1 if (expected is not None and total != expected) else 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -120,6 +192,11 @@ def main(argv=None) -> int:
 
     distributed = init_multihost()   # no-op unless a world is configured
     nodes = args.nodes or jax.device_count()
+    if args.grid_chunk_tuples is not None and nodes != 1:
+        parser.error("--grid-chunk-tuples runs the single-node out-of-core "
+                     "grid; use --nodes 1")
+    if args.resume and not args.checkpoint_dir:
+        parser.error("--resume reads the checkpoint under --checkpoint-dir")
     cfg = JoinConfig(
         num_nodes=nodes,
         num_hosts=args.hosts,
@@ -131,13 +208,36 @@ def main(argv=None) -> int:
         window_sizing=args.window_sizing,
         chunk_size=args.chunk_size,
         max_retries=args.max_retries,
+        retry_backoff_s=args.retry_backoff,
+        fallback=args.fallback,
         skew_threshold=args.skew_threshold,
         key_range=args.key_range,
         generation=args.generation,
         debug_checks=args.debug_checks,
         measure_phases=args.measure_phases,
     )
+
+    meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
+
+    engine = None
+    if args.grid_chunk_tuples is None:
+        if args.cpu_fallback:
+            from tpu_radix_join.robustness.degrade import \
+                engine_with_cpu_fallback
+            engine, dinfo = engine_with_cpu_fallback(cfg, measurements=meas)
+            if dinfo["degraded"]:
+                # structured, parseable: key=value pairs after the marker
+                print(f"[DEGRADE] failure_class={dinfo['failure_class']} "
+                      f"backend=cpu nodes={dinfo['num_nodes']} "
+                      f"error={dinfo['error']}", file=sys.stderr)
+                cfg = engine.config
+                nodes = cfg.num_nodes
+        else:
+            engine = HashJoin(cfg, measurements=meas)
+
     global_size = args.tuples_per_node * nodes
+    meas.meta.update(tuples_per_node=args.tuples_per_node,
+                     global_size=global_size, config=vars(args))
     inner = Relation(global_size, nodes, "unique", seed=args.seed)
     outer_kw = {}
     if args.outer_kind == "modulo":
@@ -148,12 +248,10 @@ def main(argv=None) -> int:
     outer = Relation(global_size, nodes, args.outer_kind,
                      seed=args.seed + 1, **outer_kw)
 
-    meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
-    meas.meta.update(tuples_per_node=args.tuples_per_node,
-                     global_size=global_size, config=vars(args))
-    engine = HashJoin(cfg, measurements=meas)
-
     expected = inner.expected_matches(outer)
+
+    if args.grid_chunk_tuples is not None:
+        return _run_grid(args, inner, outer, expected, meas)
     # Generate + place once, join --repeat times: the reference generates
     # before its join timers start (main.cpp:94-116), so repeats must not
     # re-pay generation/transfer — with host generation the device_put

@@ -22,6 +22,7 @@ observably, can this pipeline.
 from __future__ import annotations
 
 import functools
+import time
 from typing import NamedTuple, Optional
 
 import jax
@@ -64,6 +65,11 @@ from tpu_radix_join.ops.radix import local_histogram, scatter_to_blocks
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import network_partition
 from tpu_radix_join.parallel.window import ExchangeResult, Window
+from tpu_radix_join.performance.measurements import BACKOFFMS, RETRYN
+from tpu_radix_join.robustness import faults as _faults
+from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
+                                             RETRIES_EXHAUSTED, RetryPolicy,
+                                             classify_diagnostics)
 
 
 class JoinResult(NamedTuple):
@@ -100,6 +106,10 @@ class HashJoin:
 
     def __init__(self, config: JoinConfig, mesh: Optional[Mesh] = None,
                  measurements=None):
+        # injectable device-unavailable site: lets tier-1 exercise the
+        # TPU-init-failure -> CPU-fallback path (robustness/degrade.py)
+        # without a real dead accelerator
+        _faults.check(_faults.DEVICE_INIT, measurements)
         self.config = config
         if mesh is not None:
             self.mesh = mesh
@@ -1146,7 +1156,7 @@ class HashJoin:
         Window.cpp:168-177).  The trailing count-overflow entry exists only
         on the counting pipelines (the materializing probe counts matches
         from host bools — no uint32 accumulator to wrap)."""
-        return {
+        diag = {
             "key_contract_violations": int(flags[0]),   # nodes with out-of-range keys
             "shuffle_overflow_r_tuples": int(flags[1]),  # inner block capacity shortfall
             "shuffle_overflow_s_tuples": int(flags[2]),  # outer block capacity shortfall
@@ -1157,6 +1167,20 @@ class HashJoin:
             # (max_weight x outer_p bound, _count_risk)
             "count_overflow_risk": int(flags[6]) if len(flags) > 6 else 0,
         }
+        # machine-readable failure taxonomy (robustness/retry.py): callers
+        # branch on this instead of re-deriving severity from raw flags
+        diag["failure_class"] = classify_diagnostics(diag)
+        return diag
+
+    def _inject_shuffle_fault(self, flags: np.ndarray) -> np.ndarray:
+        """Fault site ``engine.shuffle_overflow``: when armed, report an
+        outer-window capacity shortfall even though the real run fit — the
+        retry loop then exercises its grow-and-respecialize path under test
+        control.  Returns ``flags`` untouched when the site is quiet."""
+        if _faults.fires(_faults.SHUFFLE_OVERFLOW, self.measurements):
+            flags = flags.copy()
+            flags[2] += 1   # outer (S) shuffle window shortfall: retryable
+        return flags
 
     @staticmethod
     def _retryable(diag: dict) -> bool:
@@ -1309,7 +1333,7 @@ class HashJoin:
                 counts, flags = fn(r, s)
                 dts = ({"JPROC": m.stop("JPROC", fence=(counts, flags))}
                        if m else {})
-                flags = np.asarray(flags)
+            flags = self._inject_shuffle_fault(np.asarray(flags))
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
@@ -1327,7 +1351,78 @@ class HashJoin:
                 # when retries are exhausted the last attempt IS the result
                 # — keep its time (see _rollback_attempt)
                 self._rollback_attempt(m, dts)
+            self._retry_backoff(attempt)
+        if (flags.any() and self._retryable(diag)
+                and self.config.fallback == "chunked"):
+            # retries exhausted on a retryable (capacity) failure: degrade
+            # to the out-of-core grid path instead of returning ok=False
+            return self._fallback_chunked(r, s, diag, cap_r, cap_s)
         return self._finish_join(r, s, counts, flags, diag, cap_r, cap_s, 1)
+
+    def _retry_backoff(self, attempt: int) -> None:
+        """Optional pause between capacity-grow retries (``JoinConfig``
+        backoff knobs, default off).  On shared hosts the respecialized
+        attempt recompiles and reallocates windows; a deterministic
+        exponential backoff keeps colocated tenants' retry storms apart."""
+        cfg = self.config
+        if cfg.retry_backoff_s <= 0 or attempt >= cfg.max_retries:
+            return
+        delay = RetryPolicy(max_attempts=cfg.max_retries + 1,
+                            base_delay_s=cfg.retry_backoff_s,
+                            multiplier=cfg.retry_backoff_mult,
+                            max_delay_s=cfg.retry_backoff_max_s,
+                            jitter=cfg.retry_jitter).delay_s(attempt)
+        m = self.measurements
+        if m:
+            m.incr(RETRYN)
+            m.incr(BACKOFFMS, int(delay * 1000))
+            m.event("retry", site="engine.capacity", attempt=attempt,
+                    delay_s=round(delay, 6))
+        time.sleep(delay)
+
+    def _fallback_chunked(self, r: TupleBatch, s: TupleBatch, diag: dict,
+                          cap_r: int, cap_s: int) -> JoinResult:
+        """Graceful degradation: the shuffle windows cannot be sized for
+        this workload within ``max_retries`` doublings, so finish the join
+        out-of-core (ops/chunked.py).  The chunked count's only capacity is
+        the slab size — chosen here, not measured — so it cannot overflow;
+        it is slower (host slabs, no all_to_all overlap) but returns the
+        exact count where the engine path would return ok=False."""
+        m = self.measurements
+        from tpu_radix_join.ops.chunked import chunked_join_count
+        diag = dict(diag, failure_class=CAPACITY_OVERFLOW,
+                    degraded="chunked")
+        try:
+            slab = min(1 << 20, s.size)
+            matches = chunked_join_count(
+                TupleBatch(key=jnp.asarray(self._to_host(r.key)), rid=r.rid,
+                           key_hi=None if r.key_hi is None
+                           else jnp.asarray(self._to_host(r.key_hi))),
+                TupleBatch(key=jnp.asarray(self._to_host(s.key)), rid=s.rid,
+                           key_hi=None if s.key_hi is None
+                           else jnp.asarray(self._to_host(s.key_hi))),
+                slab, key_range="auto")
+        except Exception as e:   # degraded path must never raise past here
+            diag["fallback_error"] = repr(e)
+            diag["failure_class"] = RETRIES_EXHAUSTED
+            if m:
+                m.stop("JTOTAL")
+                m.event("fallback", path="chunked", ok=False, error=repr(e))
+                m.derive_rates()
+            return JoinResult(matches=0, ok=False,
+                              partition_counts=np.zeros(1, np.uint32),
+                              diagnostics=diag)
+        if m:
+            m.stop("JTOTAL")
+            m.incr("RESULTS", matches)
+            m.incr("RTUPLES", r.size)
+            m.incr("STUPLES", s.size)
+            m.event("fallback", path="chunked", ok=True, slab=slab)
+            m.derive_rates()
+        return JoinResult(matches=matches, ok=True,
+                          partition_counts=np.asarray([matches % (1 << 32)],
+                                                      np.uint32),
+                          diagnostics=diag)
 
     def _finish_join(self, r: TupleBatch, s: TupleBatch, counts, flags,
                      diag: dict, cap_r: int, cap_s: int,
@@ -1392,7 +1487,7 @@ class HashJoin:
                 r_rid, s_rid, valid, flags = fn(r, s)
                 dts = ({"JPROC": m.stop("JPROC", fence=(r_rid, flags))}
                        if m else {})
-                flags = np.asarray(flags)
+            flags = self._inject_shuffle_fault(np.asarray(flags))
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break

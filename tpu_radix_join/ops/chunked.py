@@ -19,7 +19,6 @@ probed exactly once, matching the LD kernels' two-level iterCount indexing).
 from __future__ import annotations
 
 import functools
-import json
 import os
 from typing import Tuple
 
@@ -115,6 +114,7 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
     n = keys.shape[0]
     pad = (-n) % slab_size
     fill = pad_sentinel("outer")
+    mx_narrow = None
     if pad:
         keys = jnp.concatenate(
             [keys, jnp.full((pad,), fill, keys.dtype)])
@@ -145,6 +145,24 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
                                               (n + pad) // slab_size)
         else:
             per_slab, maxw = _scan_probe(r.key, keys, (n + pad) // slab_size)
+            if key_range == "narrow":
+                # "narrow" asserts a static key bound instead of paying
+                # "auto"'s pre-scan sync — but an asserted contract still
+                # has to be *checked*: keys above the 31-bit packing land on
+                # the reserved pack-pads and count zero matches, an
+                # undercount with ok-looking output.  Dispatch the max-key
+                # reduction after the scan so it rides the maxw readback
+                # below (detection without the extra sync point).
+                mx_narrow = jnp.maximum(jnp.max(r.key), jnp.max(s.key))
+    if mx_narrow is not None:
+        mx = int(np.asarray(mx_narrow))
+        if mx > MAX_MERGE_KEY:
+            raise ValueError(
+                f"key contract violation: key_range='narrow' but max key "
+                f"{mx:#x} exceeds the 31-bit packing limit "
+                f"{MAX_MERGE_KEY:#x} — such keys pack to the reserved "
+                f"zero-match pads (silent undercount); use key_range='full' "
+                f"or 'auto'")
     # uint32-overflow guard: every accumulation window (the per-slab total
     # and the 1024-position chunk partials inside it) is bounded by
     # max_weight x window width; a wrapped window would return a wrong count
@@ -162,7 +180,10 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                       checkpoint_path: str | None = None,
                       checkpoint_tag: str = "",
                       progress: bool = False,
-                      key_range: str = "auto") -> int:
+                      key_range: str = "auto",
+                      measurements=None,
+                      retry_policy=None,
+                      retry_on=None) -> int:
     """Both sides streamed; each inner chunk is joined against every outer
     chunk exactly once.
 
@@ -184,6 +205,17 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
     against resuming a different join from a stale file — pass a tag that
     identifies the input relations; mismatches raise instead of silently
     returning the wrong total, and unreadable files restart from zero.
+    Checkpoint mechanics (atomic rename, corruption policy, counters) live
+    in robustness/checkpoint.CheckpointManager.
+
+    ``measurements`` (optional) receives CKPTSAVE/CKPTLOAD from the
+    manager plus GRIDPAIRS — the number of chunk pairs actually probed,
+    which a resumed run keeps at (total pairs - completed pairs): the
+    zero-recompute guarantee tests assert on.  ``retry_policy`` (a
+    robustness.retry.RetryPolicy) retries each pair probe on transient
+    errors (``retry_on`` exception classes, default the injectable
+    TransientFault) — the chip-tunnel hiccup that killed three rounds of
+    128M/1B grids (VERDICT r5) instead of costing one backoff.
     """
     if callable(s_chunks):
         s_iter = s_chunks
@@ -197,40 +229,30 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
             "checkpoint_path requires a checkpoint_tag identifying the input "
             "relations — an untagged checkpoint resumed against different "
             "data would silently return a wrong total")
+    from tpu_radix_join.performance.measurements import GRIDPAIRS
+    from tpu_radix_join.robustness import faults as _faults
+    from tpu_radix_join.robustness.checkpoint import CheckpointManager
+    from tpu_radix_join.robustness.retry import execute as _retry_execute
+
     fingerprint = {"slab": int(slab_size), "tag": checkpoint_tag,
                    "rows": len(r_chunks) if isinstance(r_chunks, (list, tuple))
                    else None,
                    "cols": len(s_chunks) if isinstance(s_chunks, (list, tuple))
                    else None}
+    ckpt = (CheckpointManager(checkpoint_path, fingerprint, measurements)
+            if checkpoint_path else None)
     start_i, start_j, total = 0, 0, 0
-    if checkpoint_path and os.path.exists(checkpoint_path):
-        try:
-            with open(checkpoint_path) as f:
-                state = json.load(f)
-            if state["fingerprint"] != fingerprint:
-                raise ValueError(
-                    f"checkpoint {checkpoint_path} belongs to a different "
-                    f"join ({state['fingerprint']} != {fingerprint}); remove "
-                    "it or pass a distinct checkpoint_tag")
+    if ckpt is not None:
+        state = ckpt.load()
+        if state is not None:
             if state.get("done"):
                 return int(state["total"])
             start_i, start_j = int(state["i"]), int(state["j"])
             total = int(state["total"])
-        except (json.JSONDecodeError, KeyError, OSError):
-            # truncated/corrupt checkpoint: restart from zero rather than
-            # wedging every rerun on an unreadable file
-            start_i, start_j, total = 0, 0, 0
 
     def save(i: int, j: int, total: int, done: bool = False) -> None:
-        if not checkpoint_path:
-            return
-        tmp = f"{checkpoint_path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            json.dump({"i": i, "j": j, "total": total, "done": done,
-                       "fingerprint": fingerprint}, f)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, checkpoint_path)
+        if ckpt is not None:
+            ckpt.save({"i": i, "j": j, "total": total}, done=done)
 
     import time as _time
 
@@ -290,9 +312,27 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                 if j < row_start_j:
                     continue
                 yield_chip()
-                total += chunked_join_count(r, s,
-                                            min(slab_size, s.key.shape[0]),
-                                            key_range=key_range)
+                # a simulated hard kill lands between the last save and the
+                # next probe — the checkpoint already covers every finished
+                # pair, so the resume recomputes nothing
+                _faults.check(_faults.GRID_KILL, measurements)
+
+                def probe(r=r, s=s):
+                    _faults.check(_faults.GRID_TRANSIENT, measurements)
+                    return chunked_join_count(r, s,
+                                              min(slab_size, s.key.shape[0]),
+                                              key_range=key_range)
+
+                if retry_policy is not None:
+                    total += _retry_execute(
+                        probe, retry_policy,
+                        retryable=retry_on or (_faults.TransientFault,),
+                        measurements=measurements,
+                        label=f"grid_pair({i},{j})")
+                else:
+                    total += probe()
+                if measurements is not None:
+                    measurements.incr(GRIDPAIRS)
                 save(i, j + 1, total)
                 if progress:
                     print(f"[grid] pair ({i}, {j}) done, total={total:,}, "

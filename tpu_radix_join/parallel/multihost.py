@@ -13,21 +13,57 @@ along process boundaries so the shuffle's bulk hops ride ICI.
 This module is environment-driven and single-host-safe: with no cluster
 variables set it is a no-op, so every entry point can call it unconditionally
 (the way every reference binary calls ``MPI_Init``).
+
+Resilience (the hardening ``MPI_Init`` never had): the coordinator connect
+runs under a ``robustness.retry.RetryPolicy`` — a worker that races ahead of
+a slow coordinator backs off and retries instead of dying, and a worker that
+can never connect fails with the ``coordinator_timeout`` failure class after
+a bounded schedule rather than hanging the job.  Knobs come from the
+environment (``TPU_RJ_COORD_ATTEMPTS``, ``TPU_RJ_COORD_BACKOFF_S``,
+``TPU_RJ_COORD_TIMEOUT_S``) or an explicit ``retry_policy``.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Optional
+import time
+from typing import Callable, Optional
 
 import jax
+
+from tpu_radix_join.robustness import faults as _faults
+from tpu_radix_join.robustness.retry import (COORDINATOR_TIMEOUT,
+                                             RetriesExhausted, RetryPolicy,
+                                             execute)
 
 _initialized = False
 
 
+class CoordinatorTimeout(ConnectionError):
+    """Could not reach the distributed coordinator within policy."""
+
+    failure_class = COORDINATOR_TIMEOUT
+
+
+def _default_policy() -> RetryPolicy:
+    return RetryPolicy(
+        max_attempts=int(os.environ.get("TPU_RJ_COORD_ATTEMPTS", "3")),
+        base_delay_s=float(os.environ.get("TPU_RJ_COORD_BACKOFF_S", "1.0")),
+        multiplier=2.0,
+        max_delay_s=30.0,
+        jitter=0.1,
+        # per-process seed: ranks de-synchronize their retry storms
+        seed=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+
+
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> bool:
+               process_id: Optional[int] = None,
+               retry_policy: Optional[RetryPolicy] = None,
+               connect_timeout_s: Optional[float] = None,
+               measurements=None,
+               _sleep: Optional[Callable[[float], None]] = None) -> bool:
     """Join the multi-process world if one is configured; returns True when
     running distributed.
 
@@ -39,6 +75,14 @@ def initialize(coordinator_address: Optional[str] = None,
     ``jax.distributed.initialize()`` directly before importing this package;
     auto-detection is deliberately not replicated here because single-chip
     tunnel environments carry pod-like variables.
+
+    ``connect_timeout_s`` bounds each connect attempt (forwarded to
+    ``jax.distributed.initialize(initialization_timeout=...)`` where the
+    installed jax supports it, default
+    ``TPU_RJ_COORD_TIMEOUT_S``); retryable connect failures (timeout /
+    connection errors / the injectable ``multihost.coordinator_connect``
+    fault) back off per ``retry_policy`` and terminally raise
+    :class:`CoordinatorTimeout`.  ``_sleep`` is test-injectable.
     """
     global _initialized
     if _initialized or jax.distributed.is_initialized():
@@ -51,10 +95,51 @@ def initialize(coordinator_address: Optional[str] = None,
         process_id = int(env["JAX_PROCESS_ID"])
     if coordinator_address is None:
         return False   # single-process run; nothing to join
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id)
+    if connect_timeout_s is None and "TPU_RJ_COORD_TIMEOUT_S" in env:
+        connect_timeout_s = float(env["TPU_RJ_COORD_TIMEOUT_S"])
+
+    from tpu_radix_join.utils import compat
+    # platform read from config/env, NOT jax.default_backend(): probing the
+    # backend here would initialize it, and distributed.initialize refuses
+    # to run once any backend exists
+    platforms = (getattr(jax.config, "jax_platforms", None)
+                 or env.get("JAX_PLATFORMS") or "")
+    if compat.is_legacy() and "cpu" in platforms:
+        # legacy jaxlib's default CPU client rejects multi-process
+        # computations ("Multiprocess computations aren't implemented on
+        # the CPU backend"); its gloo collectives implementation handles
+        # them — current jax selects this automatically
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except (AttributeError, ValueError):
+            pass
+
+    kwargs = dict(coordinator_address=coordinator_address,
+                  num_processes=num_processes, process_id=process_id)
+    if connect_timeout_s is not None:
+        kwargs["initialization_timeout"] = int(connect_timeout_s)
+
+    def connect():
+        _faults.check(_faults.COORD_CONNECT, measurements)
+        try:
+            jax.distributed.initialize(**kwargs)
+        except TypeError:
+            # older jax.distributed.initialize without initialization_timeout
+            kwargs.pop("initialization_timeout", None)
+            jax.distributed.initialize(**kwargs)
+
+    policy = retry_policy or _default_policy()
+    try:
+        execute(connect, policy,
+                retryable=(ConnectionError, TimeoutError,
+                           _faults.InjectedFault, RuntimeError),
+                sleep=_sleep or time.sleep,
+                measurements=measurements,
+                label="coordinator_connect")
+    except RetriesExhausted as e:
+        raise CoordinatorTimeout(
+            f"could not reach coordinator {coordinator_address} after "
+            f"{e.attempts} attempt(s): {e.last_error!r}") from e
     _initialized = True
     return jax.process_count() > 1
 

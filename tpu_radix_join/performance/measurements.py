@@ -60,6 +60,13 @@ MWINPUTCNT = "MWINPUTCNT"  # logical block transfers shuffled (MPI_Put count ana
 MWINBYTES = "MWINBYTES"    # shuffle wire bytes incl. padding (8B/tuple slots)
 WINCAPR = "WINCAPR"        # per-(sender,dest) block capacity, inner window
 WINCAPS = "WINCAPS"        # per-(sender,dest) block capacity, outer window
+FINJECT = "FINJECT"        # injected faults fired (robustness/faults.py)
+RETRYN = "RETRYN"          # robustness-layer retry attempts (robustness/retry.py)
+BACKOFFMS = "BACKOFFMS"    # total retry backoff slept, milliseconds
+CKPTSAVE = "CKPTSAVE"      # checkpoints written (robustness/checkpoint.py)
+CKPTLOAD = "CKPTLOAD"      # checkpoints resumed from
+GRIDPAIRS = "GRIDPAIRS"    # chunk pairs actually probed by chunked_join_grid
+                           # (resume skips completed pairs — see ops/chunked.py)
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second
@@ -116,6 +123,16 @@ class Measurements:
 
     def incr(self, key: str, by: int = 1) -> None:
         self.counters[key] += by
+
+    def event(self, name: str, **data) -> None:
+        """Append a trace event to ``meta["events"]`` (lands in the
+        ``<rank>.info`` JSON).  The robustness layer records faults fired,
+        retries taken, and checkpoints written here so a post-mortem can
+        reconstruct the failure/recovery timeline without logs; values must
+        be JSON-serializable."""
+        events = self.meta.setdefault("events", [])
+        events.append({"event": name,
+                       "t_s": round(time.perf_counter(), 6), **data})
 
     # ----------------------------------------------------- detail accumulators
     def record_exchange(self, num_nodes: int, cap_r: int, cap_s: int,

@@ -1,0 +1,35 @@
+"""Fault injection, retry policies, and checkpoint/resume.
+
+The resilience layer the reference never had: its only failure contract is a
+hard ``JOIN_ASSERT`` after the RMA window exchange (Window.cpp:180-191,
+SURVEY.md §4.3).  This package gives every failure path a name, a policy,
+and a test:
+
+  * :mod:`~tpu_radix_join.robustness.faults` — seeded, deterministic
+    fault-injection registry consulted by the engine at named sites, so
+    every failure path is exercisable on CPU under tier-1.
+  * :mod:`~tpu_radix_join.robustness.retry` — ``RetryPolicy`` (max attempts,
+    exponential backoff, deterministic jitter) + the retryable-vs-fatal
+    failure-class taxonomy derived from ``JoinResult.diagnostics``.
+  * :mod:`~tpu_radix_join.robustness.checkpoint` — atomic slab-boundary
+    checkpoint/resume for out-of-core grid joins.
+  * :mod:`~tpu_radix_join.robustness.degrade` — graceful degradation
+    (accelerator-init failure -> CPU engine).  Imported lazily by callers,
+    not here: it pulls in the full engine stack.
+"""
+
+from tpu_radix_join.robustness import faults
+from tpu_radix_join.robustness.checkpoint import (CheckpointManager,
+                                                  CheckpointMismatch)
+from tpu_radix_join.robustness.retry import (RetriesExhausted, RetryPolicy,
+                                             classify_diagnostics, execute)
+
+__all__ = [
+    "faults",
+    "CheckpointManager",
+    "CheckpointMismatch",
+    "RetryPolicy",
+    "RetriesExhausted",
+    "classify_diagnostics",
+    "execute",
+]

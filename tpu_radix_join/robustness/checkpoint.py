@@ -1,0 +1,112 @@
+"""Atomic checkpoint/resume for out-of-core joins.
+
+Generalizes the checkpoint discipline that grew inside
+``ops/chunked.chunked_join_grid`` into a reusable manager, so a killed
+1B-row grid run resumes from its last completed chunk pair instead of
+restarting (the single-shot reference has no such capability, SURVEY.md
+§5.4).  File format (JSON, one object):
+
+    {"<cursor/count fields...>", "done": bool, "fingerprint": {...}}
+
+Rules:
+
+  * **Atomicity** — writes go to ``<path>.tmp.<pid>`` then ``fsync`` +
+    ``os.replace`` (the utils/locks.py rename discipline): a reader never
+    observes a torn file, a crash mid-write leaves the previous checkpoint
+    intact.
+  * **Fingerprint** — a JSON-serializable dict identifying the run
+    (slab size, input tag, grid shape, ...).  ``load`` raises
+    :class:`CheckpointMismatch` when the file's fingerprint differs:
+    resuming a *different* join from a stale file would silently return a
+    wrong total.  Callers choose the fields; equality is exact.
+  * **Corruption** — unreadable/truncated files restart from scratch
+    (``load`` returns None) rather than wedging every rerun.
+  * **Durability beats availability for writes** — a failed *save* must not
+    kill a healthy multi-hour join: I/O errors are swallowed into a
+    ``checkpoint_save_failed`` trace event (the run just loses one resume
+    point).
+
+Counters: ``CKPTSAVE`` per checkpoint written, ``CKPTLOAD`` per successful
+resume (missing files count neither).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from tpu_radix_join.performance.measurements import CKPTLOAD, CKPTSAVE
+from tpu_radix_join.robustness import faults as _faults
+from tpu_radix_join.robustness.retry import CHECKPOINT_MISMATCH
+
+
+class CheckpointMismatch(ValueError):
+    """Checkpoint fingerprint does not match the current run config."""
+
+    failure_class = CHECKPOINT_MISMATCH
+
+
+class CheckpointManager:
+    """One checkpoint file + fingerprint guard (see module docstring)."""
+
+    def __init__(self, path: str, fingerprint: dict, measurements=None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.measurements = measurements
+
+    def load(self) -> Optional[dict]:
+        """The saved state dict (including ``done``), or None when there is
+        nothing valid to resume from.  Raises :class:`CheckpointMismatch` on
+        a fingerprint conflict — never silently resumes the wrong join."""
+        m = self.measurements
+        if not os.path.exists(self.path):
+            return None
+        try:
+            _faults.check(_faults.CKPT_LOAD, m)
+            with open(self.path) as f:
+                state = json.load(f)
+            saved_fp = state.pop("fingerprint")
+        except (json.JSONDecodeError, KeyError, OSError) as e:
+            # truncated/corrupt checkpoint: restart from zero rather than
+            # wedging every rerun on an unreadable file
+            if m is not None:
+                m.event("checkpoint_corrupt", path=self.path, error=repr(e))
+            return None
+        if saved_fp != self.fingerprint:
+            raise CheckpointMismatch(
+                f"checkpoint {self.path} belongs to a different join "
+                f"({saved_fp} != {self.fingerprint}); remove it or use a "
+                f"distinct fingerprint/tag")
+        if m is not None:
+            m.incr(CKPTLOAD)
+            m.event("checkpoint_load", path=self.path,
+                    done=bool(state.get("done")))
+        return state
+
+    def save(self, state: dict, done: bool = False) -> bool:
+        """Atomically persist ``state`` (+ ``done`` + fingerprint); returns
+        False (after recording a trace event) on I/O failure instead of
+        raising — losing one resume point must not kill the join."""
+        m = self.measurements
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            _faults.check(_faults.CKPT_SAVE, m)
+            with open(tmp, "w") as f:
+                json.dump({**state, "done": done,
+                           "fingerprint": self.fingerprint}, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            if m is not None:
+                m.event("checkpoint_save_failed", path=self.path,
+                        error=repr(e))
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        if m is not None:
+            m.incr(CKPTSAVE)
+        return True

@@ -1,0 +1,67 @@
+"""Graceful degradation: build the engine somewhere, even when the
+accelerator is gone.
+
+``engine_with_cpu_fallback`` is the resilient twin of constructing
+``HashJoin`` directly: when device/mesh initialization fails (a real dead
+TPU, a mis-sized mesh, or the injectable ``engine.device_init`` fault
+site), it rebuilds over the host CPU devices instead of propagating the
+error — a correctness-preserving, slower fallback, reported loudly via a
+structured warning, a ``degrade`` trace event, and the returned info dict
+(``failure_class="device_unavailable"``).
+
+Kept out of ``robustness/__init__`` on purpose: importing it pulls the
+whole engine stack, which the leaf modules (faults/retry/checkpoint) must
+not depend on.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+from tpu_radix_join.robustness.retry import DEVICE_UNAVAILABLE
+
+
+def engine_with_cpu_fallback(config, measurements=None, mesh=None
+                             ) -> Tuple[object, dict]:
+    """(engine, info): a constructed ``HashJoin`` plus how it was obtained.
+
+    ``info["degraded"]`` is False when the primary construction succeeded;
+    on fallback it is True and ``info`` carries ``failure_class``,
+    ``error`` (repr of the primary failure), and ``backend="cpu"``.  The
+    fallback shrinks ``num_nodes`` to the available CPU device count when
+    needed (and collapses ``num_hosts`` to 1 — a degraded run is local by
+    definition), so a pod-sized config still produces a working engine.
+    CPU-construction failures propagate: with no device anywhere there is
+    nothing to degrade to.
+    """
+    from jax.sharding import Mesh
+
+    from tpu_radix_join.operators.hash_join import HashJoin
+
+    try:
+        engine = HashJoin(config, mesh=mesh, measurements=measurements)
+        return engine, {"degraded": False,
+                        "backend": jax.devices()[0].platform}
+    except Exception as e:   # noqa: BLE001 — any init failure degrades
+        primary_error = e
+
+    cpu = jax.devices("cpu")
+    n = min(config.num_nodes, len(cpu))
+    cfg = config.replace(num_nodes=n, num_hosts=1)
+    cpu_mesh = Mesh(np.asarray(cpu[:n]), (cfg.mesh_axis,))
+    engine = HashJoin(cfg, mesh=cpu_mesh, measurements=measurements)
+    info = {"degraded": True, "backend": "cpu",
+            "failure_class": DEVICE_UNAVAILABLE,
+            "num_nodes": n, "error": repr(primary_error)}
+    warnings.warn(
+        f"[DEGRADE] device init failed ({primary_error!r}); running on "
+        f"{n} CPU device(s) — expect reduced throughput", RuntimeWarning,
+        stacklevel=2)
+    if measurements is not None:
+        measurements.event("degrade", to="cpu", num_nodes=n,
+                           error=repr(primary_error))
+    return engine, info

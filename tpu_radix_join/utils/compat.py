@@ -1,0 +1,85 @@
+"""jax version-compatibility shims (graceful degradation on older jax).
+
+The codebase targets the current jax API surface (``jax.shard_map``,
+``jax.typeof``, ``jax.lax.axis_size``, ``jax.distributed.is_initialized``);
+the runtime image may carry an older jax (0.4.x) where those names live
+elsewhere or do not exist.  Rather than dying at trace time with
+``AttributeError: module 'jax' has no attribute 'shard_map'`` — the failure
+mode that took out the whole tier-1 suite on jax 0.4.37 — :func:`install`
+fills ONLY the missing attributes with behavior-compatible equivalents:
+
+  * ``jax.shard_map``          -> ``jax.experimental.shard_map.shard_map``
+    with ``check_rep=False`` (the old static replication checker has no rule
+    for ``while_loop`` and rejects programs the new checker accepts; the
+    pipeline's invariants are enforced at runtime anyway — conservation
+    flags, not tracer analysis).
+  * ``jax.typeof``             -> ``jax.core.get_aval`` (no ``vma``
+    attribute, which callers already treat as optional — see
+    ops/pallas/merge_scan.out_struct).
+  * ``jax.lax.axis_size``      -> axis-env lookup (the static mesh-axis size
+    inside shard_map bodies).
+  * ``jax.distributed.is_initialized`` -> distributed-client presence probe.
+
+Present attributes are never overwritten, so on a current jax ``install()``
+is a no-op.  Called once from ``tpu_radix_join/__init__`` — import order
+does not matter because all patched names are resolved at call time.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+_installed = False
+_legacy = False
+
+
+def is_legacy() -> bool:
+    """True when :func:`install` had to shim ``jax.shard_map`` — the marker
+    for an old jax/XLA pair.  Code paths that trip known old-XLA bugs key off
+    this (e.g. histograms/assignment_map.py unrolls its LPT scan because the
+    bundled XLA's sharding propagation aborts on while-loops feeding sharded
+    outputs: ``Check failed: new_num_elements == num_elements() (1 vs. 0)``
+    in TileAssignment::Reshape)."""
+    return _legacy
+
+
+def install() -> None:
+    """Idempotently fill missing jax API names (never overwrites)."""
+    global _installed, _legacy
+    if _installed:
+        return
+    _installed = True
+
+    if not hasattr(jax, "shard_map"):
+        _legacy = True
+        from jax.experimental.shard_map import shard_map as _shard_map
+        jax.shard_map = functools.partial(_shard_map, check_rep=False)
+
+    if not hasattr(jax, "typeof"):
+        from jax.core import get_aval as _get_aval
+        jax.typeof = _get_aval
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax._src import core as _core
+
+        def _axis_size(axis_name):
+            if isinstance(axis_name, (tuple, list)):
+                size = 1
+                for ax in axis_name:
+                    size *= _axis_size(ax)
+                return size
+            return _core.get_axis_env().axis_size(axis_name)
+
+        jax.lax.axis_size = _axis_size
+
+    if not hasattr(jax.distributed, "is_initialized"):
+        def _is_initialized() -> bool:
+            try:
+                from jax._src import distributed as _dist
+                return _dist.global_state.client is not None
+            except (ImportError, AttributeError):
+                return False
+
+        jax.distributed.is_initialized = _is_initialized
