@@ -46,7 +46,24 @@ class _BackendDown(ConnectionError):
     """One backend probe failed (tunnel down / device init error)."""
 
 
-def _wait_for_backend():
+def _planned_strategy(size, iters):
+    """What the planner would run for the bench workload (pure host math —
+    needs no live backend).  Stamped into the BENCH json on success AND on
+    a backend-unavailable exit, so even a round whose capture is otherwise
+    empty records which discipline the round intended to measure."""
+    try:
+        from tpu_radix_join.planner import Workload, load_profile, plan_join
+        plan, _ = plan_join(load_profile(), Workload(
+            r_tuples=size, s_tuples=size, key_bound=size,
+            num_nodes=1, repeats=iters))
+        return {"strategy": plan.strategy,
+                "predicted_ms": plan.predicted_ms,
+                "profile": plan.profile_name}
+    except Exception as e:       # a planner bug must not sink the bench
+        return {"strategy": "unknown", "error": repr(e)}
+
+
+def _wait_for_backend(planned=None):
     """Probe the device backend, retrying a downed tunnel for up to
     BENCH_TUNNEL_WAIT_SEC (default 20 min) before giving up.
 
@@ -65,6 +82,7 @@ def _wait_for_backend():
                                                  RetryPolicy, execute)
 
     budget = float(os.environ.get("BENCH_TUNNEL_WAIT_SEC", "1200"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT_SEC", "120"))
     attempts = [0]
 
     def probe():
@@ -78,9 +96,10 @@ def _wait_for_backend():
                  "p = os.environ.get('JAX_PLATFORMS')\n"
                  "p and jax.config.update('jax_platforms', p)\n"
                  "print(jax.devices()[0])"],
-                capture_output=True, text=True, timeout=120)
+                capture_output=True, text=True, timeout=probe_timeout)
         except subprocess.TimeoutExpired:
-            raise _BackendDown("probe hung 120s (tunnel down)")
+            raise _BackendDown(f"probe hung {probe_timeout:.0f}s "
+                               f"(tunnel down)")
         if p.returncode != 0:
             raise _BackendDown((p.stderr.strip().splitlines() or ["?"])[-1])
         print(f"note: device: {p.stdout.strip()} "
@@ -98,8 +117,23 @@ def _wait_for_backend():
         execute(probe, policy, retryable=(_BackendDown,),
                 on_retry=on_retry, label="backend_probe")
     except RetriesExhausted as e:
+        from tpu_radix_join.robustness.retry import BACKEND_UNAVAILABLE
         print(f"ERROR: device backend unavailable after {e.attempts} probes "
               f"over {budget:.0f}s: {e.last_error}", file=sys.stderr)
+        # a machine-readable capture instead of a bare rc=2: the round's
+        # BENCH artifact records what failed and what would have run
+        print(json.dumps({
+            "metric": "single_chip_join_throughput",
+            "value": 0.0,
+            "unit": "tuples/sec",
+            "vs_baseline": 0.0,
+            "failure_class": BACKEND_UNAVAILABLE,
+            "planned_strategy": (planned or {}).get("strategy", "unknown"),
+            "planned": planned,
+            "probe_attempts": e.attempts,
+            "wait_budget_s": budget,
+            "last_error": str(e.last_error),
+        }))
         sys.exit(2)
 
 
@@ -147,7 +181,9 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
 
 
 def main():
-    _wait_for_backend()
+    size = 1 << 24               # 16M tuples per side
+    planned = _planned_strategy(size, iters=20)
+    _wait_for_backend(planned)
     # Cooperative chip reservation: long-running grid experiments
     # (chunked_join_grid) park between chunk pairs while this PID-stamped
     # file exists, so a background out-of-core run on the shared single
@@ -221,8 +257,6 @@ def main():
     import jax.numpy as jnp
     from tpu_radix_join.data.relation import Relation
     from tpu_radix_join.ops.merge_count import merge_count_chunks, merge_count_pallas
-
-    size = 1 << 24               # 16M tuples per side
 
     r_rel = Relation(size, 1, "unique", seed=1)
     s_rel = Relation(size, 1, "unique", seed=2)
@@ -359,6 +393,8 @@ def main():
         "sort_gbps": round(sort_gbps, 1),
         "hbm_envelope_gbps": 105.0,
         "sort_gbps_source": sort_src,
+        "planned_strategy": planned.get("strategy", "unknown"),
+        "planned": planned,
     }))
 
 

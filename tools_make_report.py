@@ -1,6 +1,8 @@
 """Assemble the round's chip evidence into one summary table.
 
     python tools_make_report.py [artifacts/chip_r5]
+    python tools_make_report.py artifacts/chip_r5 --emit-profile out.json \
+        [--profile-name v5e_r5]
 
 Reads every perf dir (`<rank>.perf`/`<rank>.info`), trace breakdown
 (`trace_*/breakdown.json`), and task log under the artifact dir and prints a
@@ -8,6 +10,13 @@ markdown summary (per-workload phase columns in ms/join net of repeats,
 JPROCRATE, CTOTAL where present, trace sort shares, runner task status).
 The output is the raw material for BASELINE.md's achieved tables — numbers
 come straight from the committed artifacts, no hand transcription.
+
+``--emit-profile`` distills the same artifacts into a planner device
+profile (tpu_radix_join/planner/profile.py) instead of a table: measured
+SDISPATCH becomes ``dispatch_floor_ms``, a device-plane sort-discipline
+trace breakdown becomes ``sort_stage_unit_ms``, every derived constant
+cites the artifact it came from, and constants the artifacts cannot
+measure keep the base profile's committed values + citations.
 """
 
 import glob
@@ -58,8 +67,82 @@ def perf_row(d):
     return row
 
 
+def emit_profile(base_dir: str, out_path: str, name: str = None) -> int:
+    """Distill one round's chip artifacts into a planner device profile."""
+    from tpu_radix_join.performance.trace import _is_device_plane
+    from tpu_radix_join.planner.profile import (SORT_REF_ELEMS, load_profile,
+                                                sort_stage_units)
+
+    base = load_profile()
+    updates = {}
+
+    # dispatch floor: the per-program SDISPATCH column; median over ranks
+    # and runs (a single outlier dispatch must not define the profile)
+    floors = []
+    for d in sorted(glob.glob(os.path.join(base_dir, "perf_*"))):
+        for m in Measurements.load(d) or []:
+            if "SDISPATCH" in m.times_us:
+                floors.append((m.times_us["SDISPATCH"] / 1e3,
+                               os.path.basename(d)))
+    if floors:
+        floors.sort()
+        val, src = floors[len(floors) // 2]
+        updates["dispatch_floor_ms"] = {
+            "value": round(val, 3),
+            "source": f"artifact:{base_dir}/{src} SDISPATCH "
+                      f"(median of {len(floors)} runs)"}
+
+    # sort stage unit: newest device-plane sort-discipline trace breakdown,
+    # normalized by the stage model (unit = t / ((M/ref) * U(M)))
+    for path in sorted(glob.glob(os.path.join(base_dir, "trace_*",
+                                              "breakdown.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                bd = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if (bd.get("sort_share") and bd.get("size")
+                and bd.get("discipline", "sort") == "sort"
+                and _is_device_plane(bd.get("plane", ""))):
+            union = 2 * int(bd["size"])
+            t_sort = bd["busy_us"] * bd["sort_share"] / bd["iters"] / 1e3
+            unit = t_sort / ((union / SORT_REF_ELEMS)
+                            * sort_stage_units(union))
+            updates["sort_stage_unit_ms"] = {
+                "value": round(unit, 5),
+                "source": f"artifact:{os.path.relpath(path)} "
+                          f"(sort_share over {bd['iters']} iters, "
+                          f"union {union})"}
+            break
+
+    if not updates:
+        print(f"WARNING: no distillable measurements under {base_dir}; "
+              f"emitting the base profile's committed constants unchanged",
+              file=sys.stderr)
+    prof = base.replace_constants(
+        name=name or f"{base.name}+{os.path.basename(base_dir.rstrip('/'))}",
+        **updates)
+    prof.save(out_path)
+    print(f"wrote {out_path} ({prof.name}): "
+          f"{', '.join(sorted(updates)) or 'no constants refreshed'}")
+    return 0
+
+
 def main() -> int:
-    base = sys.argv[1] if len(sys.argv) > 1 else "artifacts/chip_r5"
+    argv = sys.argv[1:]
+    emit = prof_name = None
+    if "--emit-profile" in argv:
+        i = argv.index("--emit-profile")
+        emit = argv[i + 1]
+        del argv[i:i + 2]
+    if "--profile-name" in argv:
+        i = argv.index("--profile-name")
+        prof_name = argv[i + 1]
+        del argv[i:i + 2]
+    base = argv[0] if argv else "artifacts/chip_r5"
+    if emit is not None:
+        return emit_profile(base, emit, prof_name)
     print(f"# Evidence summary: {base}\n")
 
     print("## Task status\n")

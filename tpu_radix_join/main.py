@@ -110,6 +110,24 @@ def build_parser() -> argparse.ArgumentParser:
         return iv
 
     p.add_argument("--repeat", type=positive_int, default=1)
+    p.add_argument("--plan", default=None, metavar="auto|explain|FILE",
+                   help="planner mode (tpu_radix_join.planner): 'auto' "
+                        "costs every execution discipline against the "
+                        "--profile constants and applies the cheapest "
+                        "feasible one; 'explain' prints the per-strategy "
+                        "predicted-cost table and exits; a path loads a "
+                        "previously saved JoinPlan JSON verbatim")
+    p.add_argument("--plan-cache-dir", default=None,
+                   help="persist chosen plans AND the engine's converged "
+                        "window capacities here (atomic, fingerprinted): a "
+                        "warm second run skips planning and the sizing "
+                        "pre-pass; invalidated when the profile, shapes, or "
+                        "config change")
+    p.add_argument("--profile", default="v5e_lite",
+                   help="device profile for the planner: a packaged name "
+                        "(profiles/*.json) or a JSON path, e.g. one from "
+                        "tools_make_report.py --emit-profile or "
+                        "planner.calibrate()")
     p.add_argument("--pipeline-repeats", action="store_true",
                    help="dispatch the --repeat joins asynchronously and "
                         "fence once (amortized-throughput methodology, "
@@ -119,7 +137,7 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _run_grid(args, inner, outer, expected, meas) -> int:
+def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
     """Out-of-core grid mode: both relations streamed in device-generated
     chunks, every (inner, outer) chunk pair probed exactly once, with an
     atomic checkpoint after each pair (--checkpoint-dir) so a killed run
@@ -153,7 +171,7 @@ def _run_grid(args, inner, outer, expected, meas) -> int:
         min(chunk, 1 << 20),
         checkpoint_path=ckpt_path, checkpoint_tag=tag,
         progress=True, key_range=args.key_range, measurements=meas,
-        retry_policy=policy)
+        retry_policy=policy, plan=plan)
     meas.stop("JTOTAL")
     print(f"[RESULTS] Tuples: {total}")
     if expected is not None:
@@ -219,6 +237,68 @@ def main(argv=None) -> int:
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
 
+    # ---------------------------------------------------------- planner
+    # (tpu_radix_join.planner): optional — without --plan/--plan-cache-dir
+    # the driver behaves exactly as before.
+    plan = None
+    plan_cache = None
+    if args.plan is not None or args.plan_cache_dir:
+        import dataclasses as _dc
+
+        from tpu_radix_join.planner import (JoinPlan, PlanCache, Workload,
+                                            explain_table, load_profile,
+                                            plan_join)
+        from tpu_radix_join.planner.cache import ManifestMismatch
+
+        profile = load_profile(args.profile)
+        global_size = args.tuples_per_node * nodes
+        if args.plan_cache_dir:
+            plan_cache = PlanCache(args.plan_cache_dir, profile,
+                                   measurements=meas)
+            try:
+                # multi-host guard: a cache dir written by a different
+                # topology or profile must fail fast, not desynchronize
+                plan_cache.check_manifest(jax.process_count())
+            except ManifestMismatch as e:
+                print(f"[PLAN] {e}", file=sys.stderr)
+                return 2
+            plan_cache.write_manifest(jax.process_count(),
+                                      rank=jax.process_index())
+        if args.plan in ("auto", "explain"):
+            workload = Workload(
+                r_tuples=global_size, s_tuples=global_size,
+                key_bound=global_size,   # generated keys live in [0, N)
+                num_nodes=nodes, repeats=args.repeat)
+            wl_fp = {"workload": _dc.asdict(workload)}
+            if plan_cache is not None and args.plan == "auto":
+                plan, _ = plan_cache.lookup(global_size, global_size, wl_fp)
+            if plan is None:
+                plan, costs = plan_join(profile, workload)
+                if args.plan == "explain":
+                    print(explain_table(costs, plan))
+                    return 0
+                if plan_cache is not None:
+                    plan_cache.store(global_size, global_size, wl_fp,
+                                     plan=plan)
+        elif args.plan is not None:
+            plan = JoinPlan.load(args.plan)
+        if plan is not None:
+            print(f"[PLAN] strategy={plan.strategy} engine={plan.engine} "
+                  f"predicted_ms={plan.predicted_ms:.1f} "
+                  f"profile={plan.profile_name or profile.name}")
+            meas.meta["plan"] = plan.to_dict()
+            if plan.engine == "chunked" and nodes == 1:
+                if args.grid_chunk_tuples is None:
+                    args.grid_chunk_tuples = plan.chunk_tuples or (1 << 20)
+            elif plan.engine == "chunked":
+                print("[PLAN] chunked engine is single-node; keeping the "
+                      "in-core engine at this mesh size", file=sys.stderr)
+            if plan.engine == "incore" and args.grid_chunk_tuples is None:
+                cfg = cfg.replace(**plan.config_kwargs())
+                if (plan.pipeline_repeats and args.repeat > 1
+                        and not cfg.measure_phases):
+                    args.pipeline_repeats = True
+
     engine = None
     if args.grid_chunk_tuples is None:
         if args.cpu_fallback:
@@ -233,7 +313,7 @@ def main(argv=None) -> int:
                 cfg = engine.config
                 nodes = cfg.num_nodes
         else:
-            engine = HashJoin(cfg, measurements=meas)
+            engine = HashJoin(cfg, measurements=meas, plan_cache=plan_cache)
 
     global_size = args.tuples_per_node * nodes
     meas.meta.update(tuples_per_node=args.tuples_per_node,
@@ -251,7 +331,7 @@ def main(argv=None) -> int:
     expected = inner.expected_matches(outer)
 
     if args.grid_chunk_tuples is not None:
-        return _run_grid(args, inner, outer, expected, meas)
+        return _run_grid(args, inner, outer, expected, meas, plan=plan)
     # Generate + place once, join --repeat times: the reference generates
     # before its join timers start (main.cpp:94-116), so repeats must not
     # re-pay generation/transfer — with host generation the device_put
@@ -272,6 +352,12 @@ def main(argv=None) -> int:
         else:
             for i in range(args.repeat):
                 result = engine.join_arrays(r_batch, s_batch)
+    # per-rank failure class rides the registry meta into the rank-0
+    # aggregate report (performance.print_results): a multi-rank run where
+    # one rank degraded must say so in the summary, not only in that
+    # rank's own .info file
+    meas.meta["failure_class"] = (result.diagnostics or {}).get(
+        "failure_class", "ok" if result.ok else "unknown")
     if args.repeat > 1:
         # RESULTS accumulates per join; the report's "Tuples" line means THE
         # join's result count.  Times/tuple counters stay cumulative (JRATE

@@ -105,12 +105,16 @@ class HashJoin:
     """
 
     def __init__(self, config: JoinConfig, mesh: Optional[Mesh] = None,
-                 measurements=None):
+                 measurements=None, plan_cache=None):
         # injectable device-unavailable site: lets tier-1 exercise the
         # TPU-init-failure -> CPU-fallback path (robustness/degrade.py)
         # without a real dead accelerator
         _faults.check(_faults.DEVICE_INIT, measurements)
         self.config = config
+        # planner.PlanCache (or None): warm starts read the previous run's
+        # converged window capacities instead of dispatching the sizing
+        # pre-pass, and successful joins write theirs back
+        self.plan_cache = plan_cache
         if mesh is not None:
             self.mesh = mesh
         elif config.num_hosts > 1:
@@ -248,6 +252,42 @@ class HashJoin:
         for k, v in dts.items():
             if v:
                 m.times_us[k] -= v
+
+    # ------------------------------------------------------- plan cache
+    def _cache_config_fp(self) -> dict:
+        """The JoinConfig fields that window capacities depend on — two
+        configs agreeing here size identical shuffle windows for the same
+        inputs, so a cached capacity transfers between them."""
+        cfg = self.config
+        return {"num_nodes": cfg.num_nodes, "num_hosts": cfg.num_hosts,
+                "network_fanout_bits": cfg.network_fanout_bits,
+                "local_fanout_bits": cfg.local_fanout_bits,
+                "key_bits": cfg.key_bits, "two_level": cfg.two_level,
+                "probe_algorithm": cfg.probe_algorithm,
+                "assignment_policy": cfg.assignment_policy,
+                "window_sizing": cfg.window_sizing}
+
+    def _cache_eligible(self) -> bool:
+        """Warm-start capacities only apply where the sizing pre-pass would
+        run and its result is a pure function of (inputs, config): the n==1
+        specialization never sizes, "static" sizing is already free, and a
+        skew plan carries measured hot sets the cache does not model."""
+        return (self.plan_cache is not None
+                and not self._single_node_sort_probe()
+                and self.config.window_sizing == "measured"
+                and self.config.skew_threshold is None)
+
+    def _cache_store_capacities(self, r, s, cap_r: int, cap_s: int,
+                                local_slack: int, ok: bool) -> None:
+        """After a successful join, persist the *converged* capacities
+        (post any overflow-retry doublings) so the next run with this
+        (profile, shapes, config) skips the sizing pre-pass entirely."""
+        if not ok or not self._cache_eligible():
+            return
+        self.plan_cache.store(
+            r.size, s.size, self._cache_config_fp(),
+            capacities={"cap_r": cap_r, "cap_s": cap_s,
+                        "local_slack": local_slack})
 
     def _single_node_sort_probe(self) -> bool:
         """True when the pipeline takes the n==1 specialization (no shuffle,
@@ -1295,11 +1335,22 @@ class HashJoin:
                 m.meta["key_range"] = ("full" if self._full_range
                                        else "narrow")
             m.start("SWINALLOC")
-        cap_r, cap_s, skew_plan = self._measure_capacities(
-            r, s, shuffles=not self._single_node_sort_probe())
+        local_slack = 1
+        warm = None
+        if self._cache_eligible():
+            _, warm = self.plan_cache.lookup(r.size, s.size,
+                                             self._cache_config_fp())
+        if warm is not None:
+            # warm start: the previous run's converged capacities replace
+            # the sizing dispatch — no JHIST this join, one CKPTLOAD
+            cap_r, cap_s, skew_plan = (int(warm["cap_r"]),
+                                       int(warm["cap_s"]), None)
+            local_slack = int(warm.get("local_slack", 1))
+        else:
+            cap_r, cap_s, skew_plan = self._measure_capacities(
+                r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
-        local_slack = 1
         if repeats > 1:
             # amortized-dispatch mode: one compiled program, ``repeats``
             # async dispatches, one fence; flags read once (identical
@@ -1315,8 +1366,11 @@ class HashJoin:
                 m.stop("JPROC", fence=(counts, flags))
             flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
-            return self._finish_join(r, s, counts, flags, diag,
-                                     cap_r, cap_s, repeats)
+            result = self._finish_join(r, s, counts, flags, diag,
+                                       cap_r, cap_s, repeats)
+            self._cache_store_capacities(r, s, cap_r, cap_s, local_slack,
+                                         result.ok)
+            return result
         # the split is honored with or without a registry (a profiler-trace
         # user still gets two separate programs); only the host timers need m
         use_split = (self.config.measure_phases
@@ -1357,7 +1411,10 @@ class HashJoin:
             # retries exhausted on a retryable (capacity) failure: degrade
             # to the out-of-core grid path instead of returning ok=False
             return self._fallback_chunked(r, s, diag, cap_r, cap_s)
-        return self._finish_join(r, s, counts, flags, diag, cap_r, cap_s, 1)
+        result = self._finish_join(r, s, counts, flags, diag, cap_r, cap_s, 1)
+        self._cache_store_capacities(r, s, cap_r, cap_s, local_slack,
+                                     result.ok)
+        return result
 
     def _retry_backoff(self, attempt: int) -> None:
         """Optional pause between capacity-grow retries (``JoinConfig``

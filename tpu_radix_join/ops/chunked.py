@@ -183,7 +183,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                       key_range: str = "auto",
                       measurements=None,
                       retry_policy=None,
-                      retry_on=None) -> int:
+                      retry_on=None,
+                      plan=None) -> int:
     """Both sides streamed; each inner chunk is joined against every outer
     chunk exactly once.
 
@@ -201,7 +202,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
     with the same arguments skips completed pairs (skipped chunks are
     regenerated but not probed — generation is cheap, probes are not).  The
     file is left in place on completion with ``"done": true``.  A
-    fingerprint (slab size + caller-supplied ``checkpoint_tag``) guards
+    fingerprint (slab size + caller-supplied ``checkpoint_tag`` + the
+    planner's strategy/chunking when a ``plan`` is given) guards
     against resuming a different join from a stale file — pass a tag that
     identifies the input relations; mismatches raise instead of silently
     returning the wrong total, and unreadable files restart from zero.
@@ -239,6 +241,12 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                    else None,
                    "cols": len(s_chunks) if isinstance(s_chunks, (list, tuple))
                    else None}
+    if plan is not None:
+        # a planner-driven grid (main.py --plan) folds the plan identity in:
+        # resuming under a different chunking or strategy walks a different
+        # grid, so the stale checkpoint must mismatch, not mis-resume
+        fingerprint["plan"] = {"strategy": plan.strategy,
+                               "chunk_tuples": plan.chunk_tuples}
     ckpt = (CheckpointManager(checkpoint_path, fingerprint, measurements)
             if checkpoint_path else None)
     start_i, start_j, total = 0, 0, 0

@@ -364,6 +364,20 @@ def print_results(measurements: Iterable[Measurements],
     print(f"[RESULTS] Nodes: {len(ms)}", file=file)
     total = sum(m.counters.get(RESULTS, 0) for m in ms) // max(1, len(ms))
     print(f"[RESULTS] Tuples: {total}", file=file)
+    # per-rank failure classes (robustness/retry.py taxonomy, stamped into
+    # meta by main.py): one degraded rank must be visible in the aggregate
+    # summary, not only in that rank's own .info file.  "ok" ranks are
+    # summarized; anything else is named rank by rank.
+    classes = {m.node_id: str(m.meta.get("failure_class"))
+               for m in ms if m.meta.get("failure_class") is not None}
+    if classes:
+        bad = {rank: c for rank, c in sorted(classes.items()) if c != "ok"}
+        if bad:
+            per_rank = " ".join(f"rank{rank}={c}" for rank, c in bad.items())
+            print(f"[RESULTS] FailureClasses: {len(bad)}/{len(classes)} "
+                  f"ranks not ok — {per_rank}", file=file)
+        else:
+            print(f"[RESULTS] FailureClasses: ok x{len(classes)}", file=file)
     for k in keys:
         unit = "us" if any(k in m.times_us for m in ms) else "count"
         print(f"[RESULTS] {k}: max {agg[k]['max']:.0f} {unit}, "

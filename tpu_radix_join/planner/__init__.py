@@ -1,0 +1,33 @@
+"""Adaptive planner: calibrated device profiles, cost-model strategy
+selection, and a warm-start plan cache.
+
+The repo implements every execution discipline the reference and the paper
+imply — fused vs phase-split programs, flat vs two-level bucket probes,
+narrow vs full-range key packing, the in-core engine vs the chunked
+out-of-core grid — but until this subsystem the choice among them was
+manual: the quantitatively validated stage model (PERF_NOTES.md: sort
+floor, ~100 ms/program dispatch floor, block-scatter loop-vs-gather cliffs)
+existed only as prose.  Here it lives in code:
+
+  * :mod:`profile`    — versioned per-device calibration constants, seeded
+    from the committed round-1..3 chip measurements
+    (``profiles/v5e_lite.json``), refreshable on hardware via
+    :func:`profile.calibrate`;
+  * :mod:`cost_model` — analytic per-strategy cost from those constants;
+  * :mod:`plan`       — strategy enumeration -> :class:`plan.JoinPlan` +
+    the human-readable ``--explain`` table;
+  * :mod:`cache`      — atomic on-disk plan + converged-window-capacity
+    cache (the robustness checkpoint fingerprint discipline) so warm
+    starts skip both planning and the engine's sizing pre-pass.
+"""
+
+from tpu_radix_join.planner.cache import PlanCache
+from tpu_radix_join.planner.cost_model import StrategyCost, Workload
+from tpu_radix_join.planner.plan import JoinPlan, explain_table, plan_join
+from tpu_radix_join.planner.profile import (DeviceProfile, calibrate,
+                                            load_profile)
+
+__all__ = [
+    "DeviceProfile", "JoinPlan", "PlanCache", "StrategyCost", "Workload",
+    "calibrate", "explain_table", "load_profile", "plan_join",
+]

@@ -1,0 +1,190 @@
+"""Warm-start plan cache + multi-host run manifest.
+
+Planning is cheap; the engine's *sizing pre-pass* is not — it is one extra
+host-dispatched program per cold join (the ``_run_hist`` capacity
+measurement, ~one dispatch floor on chip).  The cache persists, per
+(profile, shapes, config) key:
+
+  * the chosen :class:`~tpu_radix_join.planner.plan.JoinPlan`, and
+  * the engine's **converged window capacities** (cap_r, cap_s after any
+    capacity-overflow retries),
+
+so a warm second run skips both planning and the pre-pass: no JHIST timer,
+one CKPTLOAD instead.
+
+Every entry is a :class:`~tpu_radix_join.robustness.checkpoint.
+CheckpointManager` file, which buys the discipline for free: atomic
+tmp+fsync+rename writes, corruption -> miss (never a crash), and an exact
+fingerprint guard — the profile fingerprint is *part of* each entry's
+fingerprint, so capacities measured under one set of calibration constants
+can never warm-start a run under different ones
+(:class:`CheckpointMismatch` is caught and surfaced as a miss + trace
+event, and the stale entry is overwritten on the next store).
+
+The key is (profile, shapes, config) — not data content — so a warm
+capacity is an *educated guess* for a rerun over different data of the
+same shape: the engine's capacity-overflow detect-and-retry loop remains
+the correctness backstop, exactly as for a cold mis-sizing.
+
+The **manifest** covers multi-host resume: rank 0 records the rank count
+and profile fingerprint next to the cached plans; a later run resuming
+against the same directory with a different topology or profile fails
+fast with :class:`ManifestMismatch` instead of desynchronizing the SPMD
+ranks (every rank must execute the identical program).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Optional, Tuple
+
+from tpu_radix_join.planner.plan import JoinPlan, PlanError
+from tpu_radix_join.planner.profile import DeviceProfile
+from tpu_radix_join.robustness.checkpoint import (CheckpointManager,
+                                                  CheckpointMismatch)
+
+MANIFEST_NAME = "manifest.json"
+
+
+class ManifestMismatch(ValueError):
+    """Plan-cache directory belongs to a different topology or profile."""
+
+
+def _canonical(doc: dict) -> str:
+    return json.dumps(doc, sort_keys=True, separators=(",", ":"))
+
+
+class PlanCache:
+    """On-disk plan + capacity cache rooted at ``cache_dir``."""
+
+    def __init__(self, cache_dir: str, profile: DeviceProfile,
+                 measurements=None):
+        self.cache_dir = cache_dir
+        self.profile = profile
+        self.measurements = measurements
+        os.makedirs(cache_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- keys
+
+    def _key_fields(self, r_tuples: int, s_tuples: int,
+                    config_fp: dict) -> dict:
+        return {"r_tuples": int(r_tuples), "s_tuples": int(s_tuples),
+                "config": config_fp}
+
+    def _entry(self, key_fields: dict) -> CheckpointManager:
+        digest = hashlib.sha256(
+            _canonical(key_fields).encode()).hexdigest()[:16]
+        path = os.path.join(self.cache_dir, f"plan_{digest}.json")
+        fingerprint = {"profile": self.profile.fingerprint(), **key_fields}
+        return CheckpointManager(path, fingerprint,
+                                 measurements=self.measurements)
+
+    # ------------------------------------------------------------ lookup
+
+    def lookup(self, r_tuples: int, s_tuples: int, config_fp: dict
+               ) -> Tuple[Optional[JoinPlan], Optional[dict]]:
+        """(plan, capacities) on a hit; (None, None) on a miss.  A
+        fingerprint conflict (same shapes, different profile constants) or
+        a corrupt entry is a miss, recorded as a trace event — a stale
+        entry must degrade to a cold start, never a wrong warm one."""
+        entry = self._entry(self._key_fields(r_tuples, s_tuples, config_fp))
+        m = self.measurements
+        try:
+            state = entry.load()
+        except CheckpointMismatch as e:
+            if m is not None:
+                m.event("plan_cache_stale", path=entry.path, error=str(e))
+            return None, None
+        if state is None:
+            return None, None
+        plan = None
+        if "plan" in state:
+            try:
+                plan = JoinPlan.from_dict(state["plan"])
+            except (TypeError, PlanError) as e:
+                if m is not None:
+                    m.event("plan_cache_corrupt", path=entry.path,
+                            error=repr(e))
+                return None, None
+        caps = state.get("capacities")
+        if m is not None:
+            m.event("plan_cache_hit", path=entry.path,
+                    strategy=plan.strategy if plan else None,
+                    warm_capacities=caps is not None)
+        return plan, caps
+
+    def store(self, r_tuples: int, s_tuples: int, config_fp: dict,
+              plan: Optional[JoinPlan] = None,
+              capacities: Optional[dict] = None) -> bool:
+        """Persist a plan and/or the engine's converged window capacities
+        (the engine stores capacity-only entries when it runs unplanned).
+        Overwrites stale entries; save failures degrade to a trace event,
+        same as checkpoints."""
+        entry = self._entry(self._key_fields(r_tuples, s_tuples, config_fp))
+        # merge with the existing entry (a planned run stores the plan
+        # first, the engine adds capacities after converging) — read via an
+        # uninstrumented manager: CKPTLOAD counts *warm starts*, not the
+        # read-modify-write here
+        probe = CheckpointManager(entry.path, entry.fingerprint,
+                                  measurements=None)
+        try:
+            state = probe.load() or {}
+        except CheckpointMismatch:
+            state = {}          # stale entry: overwrite
+        state.pop("done", None)
+        if plan is not None:
+            state["plan"] = plan.to_dict()
+        if capacities is not None:
+            state["capacities"] = {k: int(v) for k, v in capacities.items()}
+        return entry.save(state, done=True)
+
+    # ---------------------------------------------------------- manifest
+
+    def manifest_path(self) -> str:
+        return os.path.join(self.cache_dir, MANIFEST_NAME)
+
+    def write_manifest(self, num_ranks: int, rank: int = 0) -> bool:
+        """Rank 0 stamps the directory with the run topology + profile.
+        Non-zero ranks are no-ops — one writer, everyone checks."""
+        if rank != 0:
+            return True
+        mgr = CheckpointManager(
+            self.manifest_path(),
+            {"kind": "plan_cache_manifest"},
+            measurements=None)          # manifest writes don't count CKPTSAVE
+        return mgr.save({"num_ranks": int(num_ranks),
+                         "profile": self.profile.fingerprint()}, done=True)
+
+    def check_manifest(self, num_ranks: int) -> None:
+        """Raise :class:`ManifestMismatch` when this directory was written
+        by a different topology or profile; silently pass when no manifest
+        exists yet (fresh directory)."""
+        path = self.manifest_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path) as f:
+                state = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            # corrupt manifest: treat like a fresh dir (entries still carry
+            # their own fingerprints, so safety does not depend on it)
+            if self.measurements is not None:
+                self.measurements.event("manifest_corrupt", path=path)
+            return
+        saved_ranks = state.get("num_ranks")
+        saved_profile = state.get("profile")
+        if saved_ranks != int(num_ranks):
+            raise ManifestMismatch(
+                f"plan cache {self.cache_dir} was written by a "
+                f"{saved_ranks}-rank run; this run has {num_ranks} ranks — "
+                f"resuming would desynchronize the SPMD program. Use a "
+                f"fresh --plan-cache-dir or rerun at the original size.")
+        if saved_profile != self.profile.fingerprint():
+            raise ManifestMismatch(
+                f"plan cache {self.cache_dir} was written under profile "
+                f"{(saved_profile or {}).get('name')!r} with different "
+                f"constants than {self.profile.name!r} — cached capacities "
+                f"are not transferable across calibrations. Use a fresh "
+                f"--plan-cache-dir.")

@@ -1,0 +1,264 @@
+"""Analytic per-strategy join cost from a calibrated device profile.
+
+The stage model here is the one PERF_NOTES.md carries as prose, validated
+against the committed round-1..3 chip measurements:
+
+  * the XLA sort emitter costs ``unit * (M / 33.5M) * U(M)`` stage-units
+    (``U = k(k+1)/2``, ``k = ceil(log2 M)``) — predicts the measured flat
+    sorts at 16M/33.5M to within a few percent;
+  * every non-sort pass is bandwidth-bound at the sustained HBM envelope;
+  * each host-dispatched program pays a non-pipelining dispatch floor
+    (~100 ms through the tunnel), which is why the fused pipeline beats
+    the phase split and why ``--pipeline-repeats`` closes the driver gap;
+  * the only fast destination-grouping engine is itself a sort
+    (``scatter_to_blocks``' loop discipline), which is why the two-level
+    bucket path trails the flat sort champion.
+
+Every coefficient comes from the :class:`~tpu_radix_join.planner.profile.
+DeviceProfile` — never a literal here — so the model recalibrates with the
+hardware and every term stays citable to a measurement tag.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Tuple
+
+from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY
+from tpu_radix_join.planner.profile import (DeviceProfile, SORT_REF_ELEMS,
+                                            sort_stage_units)
+
+#: Bytes per tuple on the wire / in HBM per lane (uint32 key + uint32 rid;
+#: wide keys add a third uint32 lane).
+LANE_BYTES = 4
+
+#: Working-set multiplier of the in-core engine over the raw relation
+#: bytes: inputs + the packed union + sort double-buffering + shuffle
+#: receive windows (allocation slack).  Conservative by design — crossing
+#: the budget routes to the chunked grid, whose only cost is time.
+INCORE_WORKING_FACTOR = 6.0
+
+#: Program counts per discipline (dispatch-floor multiplier).  The sizing
+#: pre-pass is one program (skipped single-node and on plan-cache warm
+#: starts); the fused pipeline is one; the phase split runs shuffle+probe
+#: (sort path) or shuffle+LP+build+probe (bucket path) separately.
+PROGRAMS = {
+    "fused": 1,
+    "split_sort": 2,
+    "split_bucket": 4,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Workload:
+    """What the planner knows before running: global relation sizes, the
+    static key bound (``Relation.key_bound()``; None = unknown), mesh
+    size, repeat count, and an optional memory-budget override (defaults
+    to the profile's HBM envelope)."""
+
+    r_tuples: int
+    s_tuples: int
+    key_bound: Optional[int] = None      # exclusive upper bound on keys
+    key_bits: int = 32
+    num_nodes: int = 1
+    repeats: int = 1
+    memory_budget_bytes: Optional[int] = None
+
+    def budget(self, profile: DeviceProfile) -> float:
+        if self.memory_budget_bytes is not None:
+            return float(self.memory_budget_bytes)
+        return profile.value("hbm_bytes")
+
+    @property
+    def lanes(self) -> int:
+        """HBM lanes per tuple (key [+ key_hi] + rid)."""
+        return 3 if self.key_bits == 64 else 2
+
+    @property
+    def union_per_node(self) -> int:
+        return max(1, (self.r_tuples + self.s_tuples) // max(
+            1, self.num_nodes))
+
+
+@dataclasses.dataclass(frozen=True)
+class StrategyCost:
+    """One row of the ``--explain`` table: a strategy, its feasibility,
+    the predicted per-join cost, and the per-term breakdown (ms) so a
+    misprediction is debuggable against the chip logs term by term."""
+
+    strategy: str
+    cost_ms: float
+    feasible: bool
+    terms: Dict[str, float]
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+# --------------------------------------------------------------- primitives
+
+def sort_ms(profile: DeviceProfile, elems: int, lane_factor: float = 1.0,
+            rows: int = 1) -> float:
+    """Stage-model cost of sorting ``elems`` total elements, optionally as
+    ``rows`` independent batched rows (stage count follows row length —
+    the batched-sort discount of the PERF_NOTES round-2 table)."""
+    if elems <= 0:
+        return 0.0
+    row_len = max(2, elems // max(1, rows))
+    return (profile.value("sort_stage_unit_ms")
+            * (elems / SORT_REF_ELEMS)
+            * sort_stage_units(row_len) * lane_factor)
+
+
+def hbm_pass_ms(profile: DeviceProfile, byts: float) -> float:
+    """One read+write streaming pass over ``byts`` bytes."""
+    return 2.0 * byts / profile.value("hbm_gbps") / 1e9 * 1e3
+
+
+def shuffle_ms(profile: DeviceProfile, w: Workload) -> float:
+    """all_to_all wire time per chip: each relation ships its non-local
+    share (``local * (N-1)/N``) over ICI (PERF_NOTES mesh-scaling model)."""
+    n = w.num_nodes
+    if n <= 1:
+        return 0.0
+    local = (w.r_tuples + w.s_tuples) / n
+    wire_bytes = w.lanes * LANE_BYTES * local * (n - 1) / n
+    return wire_bytes / profile.value("ici_gbps") / 1e9 * 1e3
+
+
+def dispatch_ms(profile: DeviceProfile, programs: int) -> float:
+    return profile.value("dispatch_floor_ms") * programs
+
+
+def scatter_loop_ms(profile: DeviceProfile, elems: int) -> float:
+    """The block-scatter loop discipline's permutation cost (the second
+    radix pass's destination grouping)."""
+    return elems / profile.value("scatter_loop_melems_s") / 1e6 * 1e3
+
+
+def wide_sort_factor(profile: DeviceProfile) -> float:
+    """Derived 3-lane (64-bit hi/lo + rid) sort penalty: one extra lane
+    costs ``full_range_sort_factor - 1``; the wide path carries two
+    (PERF_NOTES round-5: 127 ms key_bits=64 escape vs 48 ms packed)."""
+    return 1.0 + 2.0 * (profile.value("full_range_sort_factor") - 1.0)
+
+
+def incore_resident_bytes(w: Workload) -> float:
+    """Modeled per-chip residency of the in-core engine."""
+    return (w.union_per_node * w.lanes * LANE_BYTES * INCORE_WORKING_FACTOR)
+
+
+def pick_chunk_tuples(profile: DeviceProfile, w: Workload) -> int:
+    """Largest power-of-two chunk whose grid working set (one inner chunk +
+    one outer chunk, sorted) fits the memory budget; clamped to [2^16,
+    2^24] (the LD kernels' 128M-tuple chunking downscaled to this chip)."""
+    budget = w.budget(profile)
+    cap = int(budget / (2 * w.lanes * LANE_BYTES * INCORE_WORKING_FACTOR))
+    cap = max(1, cap)
+    chunk = 1 << max(16, min(24, cap.bit_length() - 1))
+    return chunk
+
+
+# --------------------------------------------------------------- strategies
+
+def _narrow_feasible(w: Workload) -> Tuple[bool, str]:
+    if w.key_bits == 64:
+        return False, "64-bit keys always take the wide 3-lane path"
+    if w.key_bound is None:
+        return True, "key bound unknown; narrow assumed (engine re-checks)"
+    if w.key_bound - 1 > MAX_MERGE_KEY:
+        return (False, f"max key {w.key_bound - 1:#x} exceeds the 31-bit "
+                       f"packing limit {MAX_MERGE_KEY:#x}")
+    return True, ""
+
+
+def enumerate_strategies(profile: DeviceProfile,
+                         w: Workload) -> list[StrategyCost]:
+    """Cost every discipline combination for this workload.  Order is the
+    tie-break preference (first feasible minimum wins in plan_join)."""
+    union = w.union_per_node
+    union_bytes = union * w.lanes * LANE_BYTES
+    narrow_ok, narrow_why = _narrow_feasible(w)
+    full_factor = (wide_sort_factor(profile) if w.key_bits == 64
+                   else profile.value("full_range_sort_factor"))
+    sizing = 0 if w.num_nodes == 1 else 1   # the n==1 sort probe skips it
+    fits = incore_resident_bytes(w) <= w.budget(profile)
+    mem_note = ("" if fits else
+                f"resident ~{incore_resident_bytes(w) / 1e9:.1f} GB exceeds "
+                f"the {w.budget(profile) / 1e9:.1f} GB budget")
+    shuf = shuffle_ms(profile, w)
+    scan = hbm_pass_ms(profile, union_bytes)
+
+    def amortized_dispatch(programs: int, pipelinable: bool = True) -> float:
+        # pipelined repeats overlap the per-join round trip; the floor is
+        # paid once per program per *batch*, not per join (PERF_NOTES
+        # "pipelined driver repeats").  The phase split cannot pipeline —
+        # its host timers need a fence per program — so it pays per join.
+        progs = programs + sizing
+        if w.repeats > 1 and pipelinable:
+            return dispatch_ms(profile, progs) / w.repeats
+        return dispatch_ms(profile, progs)
+
+    rows = []
+
+    def add(name, feasible, terms, note=""):
+        rows.append(StrategyCost(
+            strategy=name, feasible=feasible,
+            cost_ms=round(sum(terms.values()), 3),
+            terms={k: round(v, 3) for k, v in terms.items()}, note=note))
+
+    for key_mode, lane_factor, key_ok, key_why in (
+            ("narrow", 1.0, narrow_ok, narrow_why),
+            ("full", full_factor, True, "")):
+        if w.key_bits == 64 and key_mode == "narrow":
+            add("incore_fused_sort_narrow", False,
+                {"sort": 0.0}, note=narrow_why)
+            continue
+        sort = sort_ms(profile, union, lane_factor)
+        add(f"incore_fused_sort_{key_mode}", key_ok and fits,
+            {"sort": sort, "scan": scan, "shuffle": shuf,
+             "dispatch": amortized_dispatch(PROGRAMS["fused"])},
+            note=key_why or mem_note)
+        add(f"incore_split_sort_{key_mode}", key_ok and fits,
+            {"sort": sort, "scan": scan, "shuffle": shuf,
+             "dispatch": amortized_dispatch(PROGRAMS["split_sort"],
+                                            pipelinable=False)},
+            note=(key_why or mem_note
+                  or "pays one dispatch floor per split program"))
+
+    # two-level bucket discipline: the second radix pass is a scatter
+    # (itself sort-rate-bound on this hardware) + batched per-bucket sorts;
+    # always full-range by construction (no packed merge).
+    nb = 32                                      # local fanout 5
+    twolevel = {
+        "scatter": scatter_loop_ms(profile, union),
+        "sort": sort_ms(profile, union, 1.0, rows=nb),
+        "scan": scan,
+        "shuffle": shuf,
+        "dispatch": amortized_dispatch(PROGRAMS["fused"]),
+    }
+    add("incore_fused_twolevel", fits, twolevel,
+        note=mem_note or "second radix pass rides the block-scatter loop")
+
+    # chunked out-of-core grid: every (inner, outer) chunk pair probed
+    # once; per-pair cost is a resident-sized sort + scan + one host
+    # dispatch (the grid loop is host-driven, no pipelining).
+    chunk = pick_chunk_tuples(profile, w)
+    pairs = (math.ceil(w.r_tuples / chunk) * math.ceil(w.s_tuples / chunk))
+    pair_union = min(2 * chunk, w.r_tuples + w.s_tuples)
+    grid = {
+        "sort": pairs * sort_ms(profile, pair_union, full_factor),
+        "scan": pairs * hbm_pass_ms(profile,
+                                    pair_union * w.lanes * LANE_BYTES),
+        "dispatch": dispatch_ms(profile, pairs),
+    }
+    grid_ok = w.num_nodes == 1   # the grid loop is a single-node engine
+    add("chunked_grid", grid_ok, grid,
+        note="the out-of-core grid runs single-node (ops/chunked.py)"
+             if not grid_ok else
+             f"chunk={chunk} tuples, {pairs} pair(s); the only discipline "
+             f"whose working set is bounded by the slab, not the relation"
+             if not fits else f"chunk={chunk} tuples, {pairs} pair(s)")
+    return rows

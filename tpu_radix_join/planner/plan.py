@@ -1,0 +1,194 @@
+"""Strategy selection: enumerate, cost, pick, explain.
+
+``plan_join`` turns a (profile, workload) pair into a concrete
+:class:`JoinPlan` — the knobs the driver feeds ``JoinConfig`` — plus the
+full per-strategy cost table, so ``--plan explain`` can show *why* the
+winner won and a misprediction is debuggable against the chip logs
+(compare the losing row's terms to the measured phase columns).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import List, Optional, Tuple
+
+from tpu_radix_join.planner.cost_model import (StrategyCost, Workload,
+                                               enumerate_strategies,
+                                               pick_chunk_tuples)
+from tpu_radix_join.planner.profile import DeviceProfile
+
+PLAN_SCHEMA_VERSION = 1
+
+
+class PlanError(ValueError):
+    """No feasible strategy, or a malformed plan file."""
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinPlan:
+    """The planner's decision, in driver vocabulary.
+
+    ``engine`` routes between the in-core SPMD pipeline (HashJoin) and the
+    out-of-core chunked grid (ops/chunked.py).  The remaining fields map
+    1:1 onto JoinConfig / CLI flags; ``strategy``/``predicted_ms`` record
+    the winning cost row for BENCH artifacts and cache provenance.
+    """
+
+    engine: str                       # "incore" | "chunked"
+    fused: bool = True                # False -> measure_phases (phase split)
+    probe: str = "sort"               # "sort" | "bucket"
+    two_level: bool = False
+    key_range: str = "auto"           # "narrow" | "full" | "auto"
+    network_fanout_bits: int = 5
+    local_fanout_bits: int = 5
+    chunk_tuples: Optional[int] = None   # chunked engine only
+    pipeline_repeats: bool = False
+    strategy: str = ""
+    predicted_ms: float = 0.0
+    profile_name: str = ""
+    schema_version: int = PLAN_SCHEMA_VERSION
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, doc: dict) -> "JoinPlan":
+        doc = dict(doc)
+        version = int(doc.get("schema_version", 1))
+        if version > PLAN_SCHEMA_VERSION:
+            raise PlanError(
+                f"plan schema_version {version} is newer than this build "
+                f"understands (<= {PLAN_SCHEMA_VERSION})")
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(doc) - known
+        if unknown:
+            raise PlanError(f"unknown plan fields {sorted(unknown)}")
+        if doc.get("engine") not in ("incore", "chunked"):
+            raise PlanError(f"plan engine must be incore|chunked, "
+                            f"got {doc.get('engine')!r}")
+        return cls(**doc)
+
+    def save(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    @classmethod
+    def load(cls, path: str) -> "JoinPlan":
+        try:
+            with open(path) as f:
+                return cls.from_dict(json.load(f))
+        except (OSError, json.JSONDecodeError) as e:
+            raise PlanError(f"unreadable plan file {path}: {e!r}") from e
+
+    def config_kwargs(self) -> dict:
+        """JoinConfig overrides this plan implies (in-core engine only)."""
+        return {
+            "probe_algorithm": self.probe,
+            "two_level": self.two_level,
+            "key_range": self.key_range,
+            "network_fanout_bits": self.network_fanout_bits,
+            "local_fanout_bits": self.local_fanout_bits,
+            "measure_phases": not self.fused,
+        }
+
+
+def _fanout_bits(w: Workload) -> int:
+    """Network radix bits: at least enough partitions to cover the mesh,
+    at most the default 32-way fanout, and never more partitions than
+    tuples per node (tiny relations would leave most partitions empty and
+    pay histogram width for nothing)."""
+    floor_bits = max(0, math.ceil(math.log2(max(1, w.num_nodes))))
+    per_node = max(1, w.r_tuples // max(1, w.num_nodes))
+    size_cap = max(1, per_node.bit_length() - 3)
+    return max(floor_bits, min(5, size_cap))
+
+
+def plan_join(profile: DeviceProfile, workload: Workload
+              ) -> Tuple[JoinPlan, List[StrategyCost]]:
+    """Pick the cheapest feasible strategy (ties break toward the earlier
+    row — fused before split, narrow before full) and bind it to driver
+    knobs."""
+    costs = enumerate_strategies(profile, workload)
+    feasible = [c for c in costs if c.feasible]
+    if not feasible:
+        raise PlanError(
+            "no feasible strategy for this workload — every cost row is "
+            "infeasible:\n" + explain_table(costs))
+    best = min(feasible, key=lambda c: c.cost_ms)
+    bits = _fanout_bits(workload)
+    kw = dict(network_fanout_bits=bits,
+              pipeline_repeats=workload.repeats > 1,
+              strategy=best.strategy, predicted_ms=best.cost_ms,
+              profile_name=profile.name)
+    if best.strategy == "chunked_grid":
+        plan = JoinPlan(engine="chunked",
+                        chunk_tuples=pick_chunk_tuples(profile, workload),
+                        key_range="auto" if workload.key_bound is None
+                        else ("full" if not _narrow(workload) else "narrow"),
+                        pipeline_repeats=False,
+                        **{k: v for k, v in kw.items()
+                           if k != "pipeline_repeats"})
+    elif best.strategy == "incore_fused_twolevel":
+        plan = JoinPlan(engine="incore", probe="bucket", two_level=True,
+                        key_range="auto", **kw)
+    else:
+        # incore_{fused,split}_sort_{narrow,full}
+        fused = "_fused_" in best.strategy
+        key_range = "full" if best.strategy.endswith("_full") else "narrow"
+        if workload.key_bits == 64:
+            key_range = "auto"     # wide keys have no range discipline
+        plan = JoinPlan(engine="incore", fused=fused, key_range=key_range,
+                        **kw)
+        if not fused:
+            # the split cannot pipeline (fence per program)
+            plan = dataclasses.replace(plan, pipeline_repeats=False)
+    return plan, costs
+
+
+def _narrow(w: Workload) -> bool:
+    from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY
+    return (w.key_bits == 32
+            and (w.key_bound is None or w.key_bound - 1 <= MAX_MERGE_KEY))
+
+
+def explain_table(costs: List[StrategyCost],
+                  chosen: Optional[JoinPlan] = None) -> str:
+    """Human-readable per-strategy predicted-cost table (the ``--plan
+    explain`` payload).  Terms are columns so a reader can line each up
+    against the measured phase columns in a chip perf artifact."""
+    term_keys: List[str] = []
+    for c in costs:
+        for k in c.terms:
+            if k not in term_keys:
+                term_keys.append(k)
+    header = (["strategy", "feasible", "predicted_ms"]
+              + [f"{k}_ms" for k in term_keys] + ["note"])
+    rows = []
+    for c in costs:
+        mark = (" *" if chosen is not None and c.strategy == chosen.strategy
+                else "")
+        rows.append([c.strategy + mark,
+                     "yes" if c.feasible else "NO",
+                     f"{c.cost_ms:.1f}" if c.feasible else "-"]
+                    + [f"{c.terms[k]:.1f}" if k in c.terms else ""
+                       for k in term_keys]
+                    + [c.note])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    fmt = lambda cells: "| " + " | ".join(
+        c.ljust(widths[i]) for i, c in enumerate(cells)) + " |"
+    lines = [fmt(header),
+             "|" + "|".join("-" * (w + 2) for w in widths) + "|"]
+    lines += [fmt(r) for r in rows]
+    if chosen is not None:
+        lines.append(f"chosen: {chosen.strategy} "
+                     f"(predicted {chosen.predicted_ms:.1f} ms/join, "
+                     f"profile {chosen.profile_name})")
+    return "\n".join(lines)

@@ -1,0 +1,234 @@
+"""Device profiles: versioned, cited calibration constants.
+
+A profile is a small JSON document holding every hardware constant the cost
+model (planner/cost_model.py) consumes.  Two rules keep it honest:
+
+  * **Versioned schema** — ``schema_version`` gates compatibility; loading
+    a newer schema than this code understands raises instead of guessing.
+  * **Cited constants** — every constant is ``{"value": x, "source": tag}``
+    where the tag names the measurement it came from (a PERF_NOTES table,
+    a chip artifact path, or a ``calibrate:`` microbenchmark).  A constant
+    without a source is rejected at load time, and a tier-1 test walks
+    :data:`REQUIRED_CONSTANTS` so the stage model can never silently grow
+    an uncited coefficient (tests/test_planner.py).
+
+The checked-in ``profiles/v5e_lite.json`` encodes the committed round-1..3
+measurements of the v5e "lite" behind the axon tunnel (PERF_NOTES.md);
+:func:`calibrate` refreshes the refreshable subset from on-device
+microbenchmarks, and ``tools_make_report.py --emit-profile`` distills a
+round's chip artifacts into a profile the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, Optional
+
+SCHEMA_VERSION = 1
+
+#: Constants the cost model reads.  Adding a term to cost_model.py means
+#: adding its constant here AND to every shipped profile, with a source tag
+#: — the conftest-level citation check enforces the pairing.
+REQUIRED_CONSTANTS = (
+    # XLA sort emitter cost: ms per stage-unit at the 33.5M reference size
+    # (stage model: t = unit * (M / 33.5M) * U(M), U = k(k+1)/2)
+    "sort_stage_unit_ms",
+    # measured penalty of the 2-key lexicographic (full-range) sort vs the
+    # packed single-lane sort at equal element count
+    "full_range_sort_factor",
+    # per-program host dispatch round-trip floor (does not pipeline)
+    "dispatch_floor_ms",
+    # sustained HBM bandwidth of one elementwise pass (r+w)
+    "hbm_gbps",
+    # device memory envelope the in-core engine may occupy
+    "hbm_bytes",
+    # block-scatter loop discipline: sustained M elements/s of the
+    # per-destination DMA-slice permutation (the only fast dest-grouping
+    # engine; the one-shot gather is the measured ~24x cliff)
+    "scatter_loop_melems_s",
+    # random-gather rate, the cliff side of the same measurement
+    "gather_melems_s",
+    # per-chip interconnect bandwidth the all_to_all shuffle rides
+    "ici_gbps",
+)
+
+#: Reference element count of the sort stage model's unit (PERF_NOTES
+#: round 2: 0.147 ms/stage-unit measured at the 33.5M packed union).
+SORT_REF_ELEMS = 33_554_432
+
+
+class ProfileError(ValueError):
+    """Malformed, uncited, or incompatible profile document."""
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceProfile:
+    """Immutable view of one device's calibration constants."""
+
+    name: str
+    constants: Dict[str, dict]          # key -> {"value": float, "source": str}
+    schema_version: int = SCHEMA_VERSION
+    notes: str = ""
+
+    def __post_init__(self):
+        if self.schema_version > SCHEMA_VERSION:
+            raise ProfileError(
+                f"profile {self.name!r} has schema_version "
+                f"{self.schema_version}; this build understands "
+                f"<= {SCHEMA_VERSION}")
+        for key in REQUIRED_CONSTANTS:
+            if key not in self.constants:
+                raise ProfileError(
+                    f"profile {self.name!r} is missing constant {key!r}")
+        for key, entry in self.constants.items():
+            if (not isinstance(entry, dict) or "value" not in entry
+                    or not str(entry.get("source", "")).strip()):
+                raise ProfileError(
+                    f"profile {self.name!r} constant {key!r} must be "
+                    f"{{'value': ..., 'source': <measurement tag>}} — an "
+                    f"uncited constant cannot be audited against chip logs")
+
+    def value(self, key: str) -> float:
+        try:
+            return float(self.constants[key]["value"])
+        except KeyError:
+            raise ProfileError(
+                f"profile {self.name!r} has no constant {key!r}") from None
+
+    def source(self, key: str) -> str:
+        return str(self.constants[key]["source"])
+
+    def fingerprint(self) -> dict:
+        """Stable identity for cache keys / multi-host manifests: a plan or
+        capacity cached under one profile must never warm-start a run under
+        different constants."""
+        return {"name": self.name, "schema_version": self.schema_version,
+                "constants": {k: self.constants[k]["value"]
+                              for k in sorted(self.constants)}}
+
+    def to_dict(self) -> dict:
+        return {"schema_version": self.schema_version, "name": self.name,
+                "notes": self.notes, "constants": self.constants}
+
+    def save(self, path: str) -> str:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return path
+
+    def replace_constants(self, name: Optional[str] = None,
+                          **updates: dict) -> "DeviceProfile":
+        """New profile with some constants replaced (each update a full
+        ``{"value", "source"}`` entry — recalibration never drops a
+        citation)."""
+        merged = {**self.constants, **updates}
+        return dataclasses.replace(self, name=name or self.name,
+                                   constants=merged)
+
+
+def _profiles_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "profiles")
+
+
+def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
+    """Load a profile by bare name (resolved against the packaged
+    ``profiles/`` directory) or by explicit JSON path."""
+    path = name_or_path
+    if not os.path.exists(path):
+        candidate = os.path.join(_profiles_dir(), f"{name_or_path}.json")
+        if os.path.exists(candidate):
+            path = candidate
+        else:
+            raise ProfileError(
+                f"no profile {name_or_path!r}: not a file, and "
+                f"{candidate} does not exist")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ProfileError(f"unreadable profile {path}: {e!r}") from e
+    try:
+        return DeviceProfile(
+            name=doc["name"], constants=doc["constants"],
+            schema_version=int(doc.get("schema_version", 1)),
+            notes=doc.get("notes", ""))
+    except KeyError as e:
+        raise ProfileError(f"profile {path} missing field {e}") from e
+
+
+def sort_stage_units(elems: int) -> float:
+    """U(M) = k(k+1)/2 for k = ceil(log2 M): the XLA sort emitter's
+    stage-count term, validated to <1% against the measured flat-sort
+    times at 16M/33.5M (PERF_NOTES round 3 'sort floor, quantified')."""
+    if elems <= 1:
+        return 1.0
+    k = math.ceil(math.log2(elems))
+    return k * (k + 1) / 2
+
+
+def calibrate(base: Optional[DeviceProfile] = None,
+              name: Optional[str] = None,
+              sort_elems: int = 1 << 21) -> DeviceProfile:
+    """Refresh the microbenchmark-measurable constants on the current JAX
+    backend; constants with no cheap on-device probe (memory envelope when
+    the backend hides it) keep the base profile's cited values.
+
+    Methodology matches PERF_NOTES: amortized async dispatches closed by
+    one host readback, compile excluded.  Sources are tagged
+    ``calibrate:<benchmark>`` so a calibrated profile is distinguishable
+    from the committed chip tables at a glance.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    base = base or load_profile()
+
+    def timed(fn, *args, iters=10):
+        jax.block_until_ready(fn(*args))          # compile warmup
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0])
+        return (time.perf_counter() - t0) / iters
+
+    updates = {}
+    # HBM envelope: one elementwise pass, read+write
+    n = 1 << 22
+    x = jnp.arange(n, dtype=jnp.uint32)
+    dt = timed(jax.jit(lambda a: a + jnp.uint32(1)), x)
+    updates["hbm_gbps"] = {"value": round(2 * 4 * n / dt / 1e9, 2),
+                           "source": "calibrate:elementwise_pass"}
+    # sort emitter stage unit, normalized to the 33.5M reference size
+    keys = jnp.asarray(np.random.default_rng(0).integers(
+        0, 1 << 31, sort_elems, dtype=np.uint32))
+    dt = timed(jax.jit(lambda a: jax.lax.sort(a, is_stable=False)), keys)
+    unit = dt * 1e3 / (sort_elems / SORT_REF_ELEMS) / sort_stage_units(
+        sort_elems)
+    updates["sort_stage_unit_ms"] = {"value": round(unit, 5),
+                                     "source": "calibrate:flat_sort"}
+    # dispatch floor: the trivial-program round trip
+    tiny = jnp.zeros((8,), jnp.uint32)
+    fn = jax.jit(lambda a: a + jnp.uint32(1))
+    jax.block_until_ready(fn(tiny))
+    t0 = time.perf_counter()
+    for _ in range(20):
+        jax.block_until_ready(fn(tiny))
+    updates["dispatch_floor_ms"] = {
+        "value": round((time.perf_counter() - t0) / 20 * 1e3, 3),
+        "source": "calibrate:empty_dispatch"}
+    # memory envelope, where the backend reports it
+    stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+    if stats and stats.get("bytes_limit"):
+        updates["hbm_bytes"] = {"value": int(stats["bytes_limit"]),
+                                "source": "calibrate:memory_stats"}
+    return base.replace_constants(
+        name=name or f"{base.name}+calibrated", **updates)

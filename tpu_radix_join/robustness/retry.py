@@ -30,6 +30,10 @@ and surfaced by main.py / bench reports):
     run configuration.
   * ``retries_exhausted``    — a retryable class persisted through every
     attempt (possibly after a failed fallback).
+  * ``backend_unavailable``  — the requested JAX backend never became
+    reachable within the wait budget (bench.py's pre-flight); distinct
+    from ``device_unavailable`` (init *failed*) because the remedy is
+    "retry later / check the tunnel", not "fall back to CPU".
 """
 
 from __future__ import annotations
@@ -52,6 +56,7 @@ COORDINATOR_TIMEOUT = "coordinator_timeout"
 INTERRUPTED = "interrupted"
 CHECKPOINT_MISMATCH = "checkpoint_mismatch"
 RETRIES_EXHAUSTED = "retries_exhausted"
+BACKEND_UNAVAILABLE = "backend_unavailable"
 
 #: diagnostics flags -> class, in priority order (fatal classes outrank
 #: capacity: a key-contract violation must never look retryable just because
