@@ -18,6 +18,12 @@ so the denominator is 1e9 tuples/sec/accelerator, a nominal figure for the
 reference-era GPU build/probe kernels (sm_60-class, eth.cu) on this workload;
 vs_baseline >= 1.0 therefore means beating reference-class per-accelerator
 throughput.
+
+``--check-regress BASELINE.json`` runs the observability regression gate
+as a post-step: the fresh result's numeric tags are compared against the
+baseline (tools_check_regress.py semantics), the delta table goes to
+stderr, and the process exits 1 on any regression — the JSON line above
+is printed either way.
 """
 
 import glob
@@ -181,6 +187,22 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
 
 
 def main():
+    # regression-gate post-step: parsed before any backend work so a typo'd
+    # flag fails fast instead of after a multi-minute timed run
+    check_baseline = None
+    argv = sys.argv[1:]
+    if "--check-regress" in argv:
+        i = argv.index("--check-regress")
+        if i + 1 >= len(argv):
+            print("error: --check-regress needs a baseline path",
+                  file=sys.stderr)
+            sys.exit(2)
+        check_baseline = argv[i + 1]
+        if not os.path.exists(check_baseline):
+            print(f"error: baseline {check_baseline} not found",
+                  file=sys.stderr)
+            sys.exit(2)
+
     size = 1 << 24               # 16M tuples per side
     planned = _planned_strategy(size, iters=20)
     _wait_for_backend(planned)
@@ -385,7 +407,7 @@ def main():
     print(f"note: sort stage ≈ {sort_gbps:.1f} GB/s vs ~105 GB/s sustained "
           f"envelope (traffic lower bound / time from {sort_src})",
           file=sys.stderr)
-    print(json.dumps({
+    result = {
         "metric": "single_chip_join_throughput",
         "value": round(tuples_per_sec, 1),
         "unit": "tuples/sec",
@@ -395,7 +417,14 @@ def main():
         "sort_gbps_source": sort_src,
         "planned_strategy": planned.get("strategy", "unknown"),
         "planned": planned,
-    }))
+    }
+    print(json.dumps(result))
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        if code:
+            sys.exit(code)
 
 
 if __name__ == "__main__":

@@ -310,3 +310,24 @@ def test_trace_parser_roundtrip_against_tf_proto(tmp_path):
                 acc[0] += ev.duration_ps
                 acc[1] += max(1, ev.num_occurrences)
             assert want_per_md == per_md, line_name
+
+
+def test_slim_meta_preserves_failure_class_and_events_count():
+    """gather_all's oversized-meta fallback must not drop the fields the
+    aggregate report reads: failure_class (the [RESULTS] FailureClasses
+    line), the epoch anchor (timeline merge), and how many trace events
+    were lost to the truncation."""
+    m = Measurements(node_id=2, num_nodes=4)
+    m.meta["failure_class"] = "transient_fault"
+    m.meta["giant"] = "x" * (1 << 17)
+    m.event("fault_injected", site="A")
+    m.event("retry", attempt=1)
+    slim = m._slim_meta()
+    assert slim["truncated"] is True
+    assert slim["failure_class"] == "transient_fault"
+    assert slim["epoch_s"] == m.meta["epoch_s"]
+    assert slim["events_count"] == 2
+    assert "giant" not in slim and "events" not in slim
+    # a registry with no failure and no events stays minimal
+    bare = Measurements()._slim_meta()
+    assert "failure_class" not in bare and "events_count" not in bare

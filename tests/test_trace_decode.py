@@ -1,0 +1,162 @@
+"""Wire-level test of the xplane protobuf decoder (performance/trace.py).
+
+The decoder hardcodes five field numbers of the tensorflow/tsl XSpace
+schema instead of importing tensorflow; this test hand-encodes a minimal
+XSpace on the raw wire format — a device plane, a host plane, and unknown
+fields of every wire type sprinkled in — and asserts the parse and the
+``summarize_trace`` aggregation, so a schema-number typo or a broken
+unknown-field skip fails here rather than silently mis-summarizing a real
+profiler artifact.
+"""
+
+import os
+
+from tpu_radix_join.performance.trace import (find_xplane_files,
+                                              is_device_plane, parse_xspace,
+                                              summarize_trace, top_ops)
+
+# ------------------------------------------------------------ wire encoding
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _fld(num: int, wire: int, payload) -> bytes:
+    tag = _varint((num << 3) | wire)
+    if wire == 0:
+        return tag + _varint(payload)
+    if wire == 2:
+        return tag + _varint(len(payload)) + payload
+    return tag + payload            # wire 1/5: raw fixed bytes
+
+
+def _unknowns() -> bytes:
+    """Fields no XSpace message defines, one per wire type the decoder
+    must skip: varint, 64-bit, length-delimited, 32-bit."""
+    return (_fld(99, 0, 12345)
+            + _fld(98, 1, b"\x01\x02\x03\x04\x05\x06\x07\x08")
+            + _fld(97, 2, b"opaque")
+            + _fld(96, 5, b"\xde\xad\xbe\xef"))
+
+
+def _xevent(md: int, dur_ps: int, occ: int = None) -> bytes:
+    body = _fld(1, 0, md) + _fld(3, 0, dur_ps)
+    if occ is not None:
+        body += _fld(5, 0, occ)
+    return body + _unknowns()
+
+
+def _xline(name: str, events, display: str = None) -> bytes:
+    body = _fld(2, 2, name.encode())
+    if display is not None:
+        body += _fld(11, 2, display.encode())
+    for ev in events:
+        body += _fld(4, 2, ev)
+    return body + _unknowns()
+
+
+def _md_entry(md_id: int, name: str, display: str = None) -> bytes:
+    inner = _fld(1, 0, md_id) + _fld(2, 2, name.encode())
+    if display is not None:
+        inner += _fld(4, 2, display.encode())
+    return _fld(1, 0, md_id) + _fld(2, 2, inner)
+
+
+def _xplane(name: str, lines, md_entries) -> bytes:
+    body = _fld(2, 2, name.encode())
+    for ln in lines:
+        body += _fld(3, 2, ln)
+    for entry in md_entries:
+        body += _fld(4, 2, entry)
+    return body + _unknowns()
+
+
+def _xspace(planes) -> bytes:
+    return b"".join(_fld(1, 2, p) for p in planes) + _unknowns()
+
+
+def _minimal_space() -> bytes:
+    # device plane: a sparse launch line + the busy execution line the
+    # summary must pick (sort 5us x2 + fusion 2us; 300-ps varint-boundary
+    # crumbs on the launch line)
+    device = _xplane(
+        "/device:TPU:0 (pid 1)",
+        lines=[
+            _xline("launch", [_xevent(3, 300)]),
+            _xline("steps", [_xevent(1, 3_000_000, occ=1),
+                             _xevent(1, 2_000_000, occ=1),
+                             _xevent(2, 2_000_000)],
+                   display="XLA Ops"),
+        ],
+        md_entries=[_md_entry(1, "sort.42", display="sort"),
+                    _md_entry(2, "fusion.7"),
+                    _md_entry(3, "launch_op")])
+    # host plane: busier than nothing but must lose to the device plane
+    host = _xplane(
+        "/host:CPU",
+        lines=[_xline("python", [_xevent(9, 50_000_000)])],
+        md_entries=[_md_entry(9, "host_work")])
+    return _xspace([device, host])
+
+
+# ------------------------------------------------------------------- parse
+
+
+def test_parse_xspace_planes_and_unknown_field_skipping():
+    planes = parse_xspace(_minimal_space())
+    assert [p["name"] for p in planes] == ["/device:TPU:0 (pid 1)",
+                                           "/host:CPU"]
+    dev = planes[0]
+    # display_name wins over name at both the line and metadata level
+    assert [ln[0] for ln in dev["lines"]] == ["launch", "XLA Ops"]
+    assert dev["metadata"] == {1: "sort", 2: "fusion.7", 3: "launch_op"}
+    # per-metadata accumulation: two sort events fold into one row
+    line_name, per_md = dev["lines"][1]
+    assert per_md[1] == [5_000_000, 2]
+    assert per_md[2] == [2_000_000, 1]      # occurrences default to 1
+
+
+def test_parse_xspace_empty_and_garbage_tolerance():
+    assert parse_xspace(b"") == []
+    # a space that is ONLY unknown fields parses to no planes
+    assert parse_xspace(_unknowns()) == []
+
+
+def test_is_device_plane():
+    assert is_device_plane("/device:TPU:0 (pid 1)")
+    assert is_device_plane("GPU:0 stream")
+    assert not is_device_plane("/host:CPU")
+    assert not is_device_plane("python threads")
+
+
+# ----------------------------------------------------------------- summary
+
+
+def test_summarize_trace_picks_busiest_device_line(tmp_path):
+    sub = tmp_path / "plugins" / "profile"
+    os.makedirs(sub)
+    path = str(sub / "host.xplane.pb")
+    with open(path, "wb") as f:
+        f.write(_minimal_space())
+    assert find_xplane_files(str(tmp_path)) == [path]
+
+    s = summarize_trace(str(tmp_path))
+    # the device plane wins although the host plane is 7x busier
+    assert s["plane"] == "/device:TPU:0 (pid 1)"
+    assert s["busy_us"] == 7.0              # busiest LINE, launch excluded
+    assert s["ops"] == {"sort": {"us": 5.0, "count": 2},
+                        "fusion.7": {"us": 2.0, "count": 1}}
+    assert top_ops(s, k=1) == [("sort", 5.0, 2)]
+
+
+def test_summarize_trace_empty_dir(tmp_path):
+    assert summarize_trace(str(tmp_path)) is None

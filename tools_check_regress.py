@@ -1,0 +1,72 @@
+"""Perf-regression gate CLI: fresh BENCH json vs a committed baseline.
+
+    python tools_check_regress.py BENCH_fused.json --baseline BASELINE.json
+    python tools_check_regress.py BENCH_fused.json --baseline BASELINE.json \
+        --threshold 0.25 --tag-threshold JTOTAL=0.10 --allow SWINALLOC
+
+Prints the per-tag delta table (worse% > 0 means the fresh run regressed:
+a cost tag grew or a rate tag dropped) and exits
+
+    0  no tag past its threshold (or the baseline has no numeric tags),
+    1  at least one regression,
+    2  usage / IO errors (unreadable files, bad --tag-threshold spec).
+
+``--strict`` also fails tags present in the baseline but missing from the
+fresh result — a silently vanished measurement is itself a signal.  The
+comparison logic lives in tpu_radix_join.observability.regress; bench.py
+runs the same check in-process via ``--check-regress BASELINE.json``.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_radix_join.observability.regress import (DEFAULT_THRESHOLD,
+                                                  check_files,
+                                                  parse_tag_thresholds)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools_check_regress.py",
+        description="Compare a fresh result JSON against a perf baseline.")
+    p.add_argument("fresh", help="fresh result (BENCH_*.json or any flat "
+                                 "JSON of numeric tags)")
+    p.add_argument("--baseline", required=True,
+                   help="baseline JSON (e.g. BASELINE.json)")
+    p.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                   help="default relative worsening allowed per tag "
+                        "(default %(default)s)")
+    p.add_argument("--tag-threshold", action="append", default=[],
+                   metavar="TAG=REL",
+                   help="per-tag override, repeatable (e.g. JTOTAL=0.10)")
+    p.add_argument("--allow", action="append", default=[], metavar="TAG",
+                   help="tag allowed to regress this round, repeatable")
+    p.add_argument("--strict", action="store_true",
+                   help="also fail baseline tags missing from the fresh "
+                        "result")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        tag_thr = parse_tag_thresholds(args.tag_threshold)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    try:
+        code, report = check_files(
+            args.fresh, args.baseline, threshold=args.threshold,
+            tag_thresholds=tag_thr, allow=args.allow, strict=args.strict)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(report)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,6 +3,7 @@
     python tools_make_report.py [artifacts/chip_r5]
     python tools_make_report.py artifacts/chip_r5 --emit-profile out.json \
         [--profile-name v5e_r5]
+    python tools_make_report.py artifacts/chip_r5 --emit-timeline out.json
 
 Reads every perf dir (`<rank>.perf`/`<rank>.info`), trace breakdown
 (`trace_*/breakdown.json`), and task log under the artifact dir and prints a
@@ -17,6 +18,11 @@ SDISPATCH becomes ``dispatch_floor_ms``, a device-plane sort-discipline
 trace breakdown becomes ``sort_stage_unit_ms``, every derived constant
 cites the artifact it came from, and constants the artifacts cannot
 measure keep the base profile's committed values + citations.
+
+``--emit-timeline`` merges the per-rank ``<rank>.spans.json`` files a
+``--timeline-dir`` run left under the artifact dir into one Chrome-trace
+JSON on a shared clock (observability.timeline.merge_timeline) — load the
+output in Perfetto / chrome://tracing.
 """
 
 import glob
@@ -129,9 +135,27 @@ def emit_profile(base_dir: str, out_path: str, name: str = None) -> int:
     return 0
 
 
+def emit_timeline(base_dir: str, out_path: str) -> int:
+    """Merge per-rank span files under ``base_dir`` into one Chrome trace."""
+    from tpu_radix_join.observability.timeline import merge_timeline
+
+    doc = merge_timeline(base_dir, out_path=out_path, trace_dir=base_dir)
+    if doc is None:
+        print(f"ERROR: no *.spans.json under {base_dir} — run the driver "
+              f"with --timeline-dir first", file=sys.stderr)
+        return 1
+    md = doc["metadata"]
+    spans = sum(1 for e in doc["traceEvents"] if e.get("ph") == "X")
+    instants = sum(1 for e in doc["traceEvents"] if e.get("ph") == "i")
+    print(f"wrote {out_path}: {len(md['ranks'])} rank(s), {spans} spans, "
+          f"{instants} instant events on one clock "
+          f"(t0={md['t0_epoch_s']:.3f}); load in Perfetto/chrome://tracing")
+    return 0
+
+
 def main() -> int:
     argv = sys.argv[1:]
-    emit = prof_name = None
+    emit = prof_name = timeline = None
     if "--emit-profile" in argv:
         i = argv.index("--emit-profile")
         emit = argv[i + 1]
@@ -140,7 +164,13 @@ def main() -> int:
         i = argv.index("--profile-name")
         prof_name = argv[i + 1]
         del argv[i:i + 2]
+    if "--emit-timeline" in argv:
+        i = argv.index("--emit-timeline")
+        timeline = argv[i + 1]
+        del argv[i:i + 2]
     base = argv[0] if argv else "artifacts/chip_r5"
+    if timeline is not None:
+        return emit_timeline(base, timeline)
     if emit is not None:
         return emit_profile(base, emit, prof_name)
     print(f"# Evidence summary: {base}\n")

@@ -98,6 +98,18 @@ def build_parser() -> argparse.ArgumentParser:
                    help="base seed (reference: srand(1234+nodeId), main.cpp:94)")
     p.add_argument("--output-dir", default=None,
                    help="experiment dir for .perf/.info files (default: none)")
+    p.add_argument("--timeline-dir", default=None,
+                   help="export this rank's phase spans + robustness/planner "
+                        "instant events as Chrome trace-event JSON "
+                        "(<rank>.spans.json; merge ranks with "
+                        "tools_make_report.py --emit-timeline, load in "
+                        "Perfetto)")
+    p.add_argument("--metrics-interval", type=float, default=0.0,
+                   metavar="SEC",
+                   help="sample host RSS, device HBM bytes_in_use, and the "
+                        "counter registry every SEC seconds into "
+                        "<rank>.metrics.jsonl under --timeline-dir (or "
+                        "--output-dir); 0 = off")
     p.add_argument("--trace", action="store_true",
                    help="bracket the joins with the profiler (the PAPI "
                         "total-cycles analog, Measurements.cpp:90-107,137): "
@@ -164,6 +176,7 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
                           base_delay_s=args.retry_backoff or 0.5,
                           jitter=0.1)
               if args.max_retries else None)
+    meas.set_trace_tags(strategy="chunked_grid", engine="chunked")
     meas.start("JTOTAL")
     total = chunked_join_grid(
         stream_chunks_device(inner, 0, chunk),
@@ -237,6 +250,47 @@ def main(argv=None) -> int:
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
 
+    # ---------------------------------------------------- observability
+    # (tpu_radix_join.observability): opt-in span timeline + live metrics
+    # heartbeat; without the flags the driver behaves exactly as before.
+    tracer = None
+    if args.timeline_dir:
+        os.makedirs(args.timeline_dir, exist_ok=True)
+        tracer = meas.attach_tracer(nodes=nodes)
+    sampler = None
+    if args.metrics_interval:
+        mdir = args.timeline_dir or args.output_dir
+        if not mdir:
+            parser.error("--metrics-interval writes <rank>.metrics.jsonl "
+                         "under --timeline-dir or --output-dir — pass one")
+        from tpu_radix_join.observability import MetricsSampler
+        sampler = MetricsSampler(
+            os.path.join(mdir, f"{meas.node_id}.metrics.jsonl"),
+            args.metrics_interval, measurements=meas)
+        sampler.start()
+    try:
+        return _run_driver(args, cfg, meas, distributed, nodes)
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        if tracer is not None:
+            # save in the finally: a failed/degraded run's timeline is the
+            # one a post-mortem needs most
+            path = tracer.save(args.timeline_dir,
+                               device_summary=meas.meta.get("trace"))
+            print(f"[OBS] timeline spans stored {path}", file=sys.stderr)
+
+
+def _run_driver(args, cfg, meas, distributed, nodes) -> int:
+    """Driver body after flag/observability setup (main() wraps this in the
+    tracer/sampler lifecycle so every exit path exports its timeline)."""
+    import contextlib
+    import os
+
+    import jax
+
+    from tpu_radix_join import HashJoin, Relation
+
     # ---------------------------------------------------------- planner
     # (tpu_radix_join.planner): optional — without --plan/--plan-cache-dir
     # the driver behaves exactly as before.
@@ -287,6 +341,13 @@ def main(argv=None) -> int:
                   f"predicted_ms={plan.predicted_ms:.1f} "
                   f"profile={plan.profile_name or profile.name}")
             meas.meta["plan"] = plan.to_dict()
+            # the planner's decision is a timeline instant event + span tag:
+            # a merged multi-rank trace shows WHICH discipline each rank ran
+            # next to the phases it produced (ISSUE 3 tentpole)
+            meas.event("plan_decision", strategy=plan.strategy,
+                       engine=plan.engine,
+                       predicted_ms=round(plan.predicted_ms, 3))
+            meas.set_trace_tags(strategy=plan.strategy, engine=plan.engine)
             if plan.engine == "chunked" and nodes == 1:
                 if args.grid_chunk_tuples is None:
                     args.grid_chunk_tuples = plan.chunk_tuples or (1 << 20)

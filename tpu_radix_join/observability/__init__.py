@@ -1,0 +1,30 @@
+"""Unified observability layer: span timelines, live metrics, regression gate.
+
+Three pillars over the ``performance`` registry (ISSUE 3):
+
+  * :mod:`spans` — hierarchical cross-rank span tracer; every
+    ``Measurements.start/stop`` mirrors into a Chrome-trace span, every
+    ``Measurements.event`` into an instant event; per-rank export.
+  * :mod:`metrics` — opt-in background heartbeat (``--metrics-interval``)
+    sampling host RSS, device HBM, and the counter registry to JSONL.
+  * :mod:`regress` — baseline-vs-fresh per-tag comparison behind
+    ``tools_check_regress.py`` and bench.py's ``--check-regress``.
+
+Merging per-rank span files onto one aligned clock lives in
+:mod:`timeline` (driven by ``tools_make_report.py --emit-timeline``).
+"""
+
+from tpu_radix_join.observability.metrics import MetricsSampler, load_samples
+from tpu_radix_join.observability.regress import (check_files, check_result,
+                                                  compare_tags, extract_tags,
+                                                  format_table,
+                                                  parse_tag_thresholds)
+from tpu_radix_join.observability.spans import SpanTracer
+from tpu_radix_join.observability.timeline import (find_span_files,
+                                                   merge_timeline)
+
+__all__ = [
+    "MetricsSampler", "SpanTracer", "check_files", "check_result",
+    "compare_tags", "extract_tags", "find_span_files", "format_table",
+    "load_samples", "merge_timeline", "parse_tag_thresholds",
+]

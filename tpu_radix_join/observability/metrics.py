@@ -1,0 +1,160 @@
+"""Live metrics sampler: a background heartbeat for in-flight joins.
+
+The reference prints its ``/proc/self/status`` memory probe once, after the
+join (Measurements.cpp:825-851); a multi-hour out-of-core grid run here is a
+black box until it exits.  This sampler writes one JSON line per tick to
+``<rank>.metrics.jsonl`` — host RSS/VmSize, per-device HBM ``bytes_in_use``,
+and a snapshot of the counter registry (GRIDPAIRS, CKPTSAVE, RETRYN, ...) —
+so progress and memory growth are watchable live (``tail -f``) and
+post-mortem-able (the last line is the state at death).
+
+Discipline: the sampler is a daemon thread, samples immediately on start
+(short runs still get >= 1 line), never raises into the join (a failed
+sample records its error and carries on), and flushes every line (a
+SIGKILL loses at most the current tick).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+METRICS_SUFFIX = ".metrics.jsonl"
+
+
+def host_memory() -> Dict[str, int]:
+    """VmSize/VmRSS in bytes from /proc (empty off-Linux)."""
+    out: Dict[str, int] = {}
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith(("VmSize:", "VmRSS:")):
+                    k, v = line.split(":", 1)
+                    out[k] = int(v.split()[0]) * 1024
+    except OSError:
+        pass
+    return out
+
+
+def device_memory() -> Dict[str, int]:
+    """Per-device ``bytes_in_use`` where the backend exposes memory_stats
+    (TPU/GPU do; the CPU backend returns nothing)."""
+    out: Dict[str, int] = {}
+    import jax
+    for i, dev in enumerate(jax.local_devices()):
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        if stats and "bytes_in_use" in stats:
+            out[f"device{i}_bytes_in_use"] = int(stats["bytes_in_use"])
+    return out
+
+
+class MetricsSampler:
+    """Append-only JSONL heartbeat; ``start()``/``stop()`` or use as a
+    context manager.  ``measurements`` (optional) contributes counter and
+    timer snapshots plus the epoch anchor so samples align with the span
+    timeline and ``meta["events"]``."""
+
+    def __init__(self, path: str, interval_s: float = 1.0,
+                 measurements=None):
+        if interval_s <= 0:
+            raise ValueError("interval_s must be > 0")
+        self.path = path
+        self.interval_s = float(interval_s)
+        self.measurements = measurements
+        self.samples_written = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._file = None
+        m = measurements
+        self._epoch0 = (float(m.meta["epoch_s"])
+                        if m is not None and "epoch_s" in m.meta
+                        else time.time())
+        self._mono0 = time.perf_counter()
+
+    # --------------------------------------------------------------- sampling
+    def _record(self) -> dict:
+        rel_s = time.perf_counter() - self._mono0
+        rec: dict = {
+            "t_epoch_s": round(self._epoch0 + rel_s, 6),
+            "t_rel_s": round(rel_s, 6),
+        }
+        try:
+            rec["host"] = host_memory()
+            rec["devices"] = device_memory()
+            m = self.measurements
+            if m is not None:
+                # plain dict() snapshots under the GIL; values are scalars
+                rec["counters"] = dict(m.counters)
+                rec["times_us"] = {k: round(v, 1)
+                                   for k, v in m.times_us.items()}
+                rec["open_phases"] = sorted(m._starts)
+        except Exception as e:     # a sampler tick must never kill the join
+            rec["error"] = repr(e)
+        return rec
+
+    def sample(self) -> dict:
+        """Take and persist one sample (also called by the thread loop)."""
+        rec = self._record()
+        f = self._file
+        if f is not None:
+            f.write(json.dumps(rec) + "\n")
+            f.flush()
+            self.samples_written += 1
+        return rec
+
+    # -------------------------------------------------------------- lifecycle
+    def start(self) -> "MetricsSampler":
+        if self._thread is not None:
+            return self
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        self._file = open(self.path, "a")
+        self.sample()                       # >= 1 line however short the run
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="metrics-sampler")
+        self._thread.start()
+        return self
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.sample()
+            except Exception:
+                pass                        # see class docstring
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        try:
+            self.sample()                   # final state at shutdown
+        finally:
+            f, self._file = self._file, None
+            if f is not None:
+                f.close()
+
+    def __enter__(self) -> "MetricsSampler":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def load_samples(path: str) -> list:
+    """Read a ``.metrics.jsonl`` back; unparseable lines (torn final write
+    of a killed run) are skipped."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+    return out

@@ -1,0 +1,179 @@
+"""Perf-regression gate: compare a fresh result against a baseline.
+
+Compares the numeric tags of a fresh ``BENCH_*.json`` (or any flat JSON of
+measurements — a ``summary()`` dump, a distilled profile) against a
+baseline file, with per-tag relative thresholds and a named-tag allowlist.
+The CLI wrapper (tools_check_regress.py) exits non-zero on any regression
+and prints the per-tag delta table either way, so a round's bench can gate
+a merge the way the tier-1 tests gate correctness.
+
+Direction discipline: throughput-like tags (``value``, ``vs_baseline``,
+``*RATE``, ``*gbps``) regress when they *drop*; everything else — the
+time-tag vocabulary (JTOTAL, JPROC, ``*_ms``, ``*_us``) — regresses when
+it *grows*.  A tag only in the baseline is reported as ``missing`` (a
+silently vanished measurement is itself a signal) but fails the gate only
+under ``strict``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional
+
+DEFAULT_THRESHOLD = 0.25       # bench timings through a shared tunnel are
+                               # noisy; per-tag overrides tighten hot tags
+
+# tags where larger is better (everything else is treated as a cost)
+_HIGHER_BETTER = {"value", "vs_baseline"}
+_HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
+                             "tuples_per_sec")
+# bookkeeping fields that are not measurements at all
+_SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
+
+
+def higher_is_better(tag: str) -> bool:
+    t = tag.lower()
+    return (tag in _HIGHER_BETTER
+            or any(s in t for s in _HIGHER_BETTER_SUBSTRINGS))
+
+
+def extract_tags(obj: dict) -> Dict[str, float]:
+    """Numeric measurement tags of one result JSON.
+
+    Accepts a bare BENCH dict, a ``{"tags": {...}}`` wrapper, or a runner
+    artifact wrapper whose payload sits under ``"parsed"``.
+    """
+    if isinstance(obj.get("parsed"), dict):
+        obj = obj["parsed"]
+    if isinstance(obj.get("tags"), dict):
+        obj = obj["tags"]
+    out = {}
+    for k, v in obj.items():
+        if k in _SKIP or isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[k] = float(v)
+    return out
+
+
+def parse_tag_thresholds(specs: Iterable[str]) -> Dict[str, float]:
+    """``["JTOTAL=0.10", ...]`` -> {"JTOTAL": 0.10}."""
+    out = {}
+    for spec in specs:
+        tag, _, val = spec.partition("=")
+        if not _ or not tag:
+            raise ValueError(f"bad tag threshold {spec!r} (want TAG=REL)")
+        out[tag] = float(val)
+    return out
+
+
+def compare_tags(baseline: Dict[str, float], fresh: Dict[str, float],
+                 threshold: float = DEFAULT_THRESHOLD,
+                 tag_thresholds: Optional[Dict[str, float]] = None,
+                 allow: Iterable[str] = (),
+                 strict: bool = False) -> List[dict]:
+    """Per-tag delta rows, worst regressions first.
+
+    A row's ``status``: ``regressed`` (worsened past its threshold),
+    ``allowed`` (would have regressed but is allowlisted), ``missing``
+    (baseline tag absent from fresh; regresses only under ``strict``),
+    ``new`` (fresh-only, informational), ``ok`` otherwise.
+    """
+    tag_thresholds = tag_thresholds or {}
+    allow = set(allow)
+    rows = []
+    for tag in sorted(set(baseline) | set(fresh)):
+        if tag not in baseline:
+            rows.append({"tag": tag, "base": None, "fresh": fresh[tag],
+                         "delta_rel": None, "threshold": None,
+                         "status": "new"})
+            continue
+        thr = tag_thresholds.get(tag, threshold)
+        if tag not in fresh:
+            status = ("allowed" if tag in allow
+                      else ("regressed" if strict else "missing"))
+            rows.append({"tag": tag, "base": baseline[tag], "fresh": None,
+                         "delta_rel": None, "threshold": thr,
+                         "status": status})
+            continue
+        base, new = baseline[tag], fresh[tag]
+        # signed relative delta, positive = worse (cost grew / rate fell)
+        if base == 0:
+            worse = (new - base) if not higher_is_better(tag) else (base - new)
+            delta = 0.0 if worse <= 0 else float("inf")
+        elif higher_is_better(tag):
+            delta = (base - new) / abs(base)
+        else:
+            delta = (new - base) / abs(base)
+        if delta > thr:
+            status = "allowed" if tag in allow else "regressed"
+        else:
+            status = "ok"
+        rows.append({"tag": tag, "base": base, "fresh": new,
+                     "delta_rel": delta, "threshold": thr,
+                     "status": status})
+    order = {"regressed": 0, "missing": 1, "allowed": 2, "ok": 3, "new": 4}
+    rows.sort(key=lambda r: (order[r["status"]],
+                             -(r["delta_rel"] or 0.0), r["tag"]))
+    return rows
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float) and v == float("inf"):
+        return "inf"
+    return f"{v:.4g}"
+
+
+def format_table(rows: List[dict]) -> str:
+    """Readable per-tag delta table (worse > 0 means regression)."""
+    head = ["tag", "baseline", "fresh", "worse%", "limit%", "status"]
+    body = []
+    for r in rows:
+        pct = ("-" if r["delta_rel"] is None
+               else ("inf" if r["delta_rel"] == float("inf")
+                     else f"{100 * r['delta_rel']:+.1f}"))
+        lim = "-" if r["threshold"] is None else f"{100 * r['threshold']:.0f}"
+        body.append([r["tag"], _fmt(r["base"]), _fmt(r["fresh"]),
+                     pct, lim, r["status"]])
+    widths = [max(len(row[i]) for row in [head] + body)
+              for i in range(len(head))]
+    lines = ["  ".join(h.ljust(w) for h, w in zip(head, widths)),
+             "  ".join("-" * w for w in widths)]
+    lines += ["  ".join(c.ljust(w) for c, w in zip(row, widths))
+              for row in body]
+    return "\n".join(lines)
+
+
+def regressions(rows: List[dict]) -> List[dict]:
+    return [r for r in rows if r["status"] == "regressed"]
+
+
+def check_result(fresh: dict, baseline_path: str,
+                 threshold: float = DEFAULT_THRESHOLD,
+                 tag_thresholds: Optional[Dict[str, float]] = None,
+                 allow: Iterable[str] = (),
+                 strict: bool = False) -> tuple:
+    """(exit_code, report_text) for an in-memory fresh result — the hook
+    bench.py calls as its ``--check-regress`` post-step.  A baseline with
+    no numeric tags (e.g. the repo's published-{} BASELINE.json) passes
+    with a note: nothing to compare is not a regression."""
+    with open(baseline_path) as f:
+        base = extract_tags(json.load(f))
+    if not base:
+        return 0, (f"regress-check: baseline {baseline_path} carries no "
+                   f"numeric tags; nothing to compare")
+    rows = compare_tags(base, extract_tags(fresh), threshold=threshold,
+                        tag_thresholds=tag_thresholds, allow=allow,
+                        strict=strict)
+    bad = regressions(rows)
+    verdict = (f"REGRESSED: {len(bad)} tag(s) past threshold"
+               if bad else "ok: no tag past threshold")
+    return (1 if bad else 0), format_table(rows) + "\n" + verdict
+
+
+def check_files(fresh_path: str, baseline_path: str, **kw) -> tuple:
+    with open(fresh_path) as f:
+        fresh = json.load(f)
+    return check_result(fresh, baseline_path, **kw)

@@ -1278,6 +1278,18 @@ class HashJoin:
         return int(self._to_host(
             self._maxkey_jit(r.key, s.key))) > MAX_MERGE_KEY
 
+    def _strategy_label(self) -> str:
+        """The executed discipline in the planner's strategy vocabulary
+        (planner/cost_model.enumerate_strategies) — stamped onto timeline
+        spans so traces and predicted-cost tables speak one language."""
+        cfg = self.config
+        mode = "split" if cfg.measure_phases else "fused"
+        if cfg.sort_probe:
+            kr = "full" if self._full_range else "narrow"
+            return f"incore_{mode}_sort_{kr}"
+        return (f"incore_{mode}_twolevel" if cfg.two_level
+                else f"incore_{mode}_bucket")
+
     # ------------------------------------------------------------------- run
     def join_arrays_pipelined(self, r: TupleBatch, s: TupleBatch,
                               repeats: int) -> JoinResult:
@@ -1334,6 +1346,10 @@ class HashJoin:
                 # perf artifacts self-describe which count discipline ran
                 m.meta["key_range"] = ("full" if self._full_range
                                        else "narrow")
+            # timeline spans carry the executed discipline (planner
+            # vocabulary) so a merged trace reads per rank: which strategy,
+            # which phase, when (observability/spans.py)
+            m.set_trace_tags(strategy=self._strategy_label())
             m.start("SWINALLOC")
         local_slack = 1
         warm = None

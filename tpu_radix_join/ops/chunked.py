@@ -18,6 +18,7 @@ probed exactly once, matching the LD kernels' two-level iterCount indexing).
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import os
 from typing import Tuple
@@ -299,6 +300,10 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
             if not waited:
                 print(f"[grid] paused: {pause_file} present", flush=True)
                 waited = True
+                if measurements is not None:
+                    # park/resume are timeline instants: a grid whose pairs
+                    # suddenly stretch must show WHY (bench held the chip)
+                    measurements.event("grid_parked", pause_file=pause_file)
                 if grid_file:
                     # tells the bench the chip is actually drained (the
                     # presence file alone only says the grid process lives)
@@ -307,6 +312,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
         if waited:
             if grid_file:
                 remove_pid_file(grid_file + ".parked")
+            if measurements is not None:
+                measurements.event("grid_resumed")
             print("[grid] resumed", flush=True)
 
     t0 = _time.perf_counter()
@@ -331,14 +338,18 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
                                               min(slab_size, s.key.shape[0]),
                                               key_range=key_range)
 
-                if retry_policy is not None:
-                    total += _retry_execute(
-                        probe, retry_policy,
-                        retryable=retry_on or (_faults.TransientFault,),
-                        measurements=measurements,
-                        label=f"grid_pair({i},{j})")
-                else:
-                    total += probe()
+                pair_span = (measurements.span("grid_pair", i=i, j=j)
+                             if measurements is not None
+                             else contextlib.nullcontext())
+                with pair_span:
+                    if retry_policy is not None:
+                        total += _retry_execute(
+                            probe, retry_policy,
+                            retryable=retry_on or (_faults.TransientFault,),
+                            measurements=measurements,
+                            label=f"grid_pair({i},{j})")
+                    else:
+                        total += probe()
                 if measurements is not None:
                     measurements.incr(GRIDPAIRS)
                 save(i, j + 1, total)

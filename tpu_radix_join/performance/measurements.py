@@ -88,15 +88,57 @@ class Measurements:
         self._starts: Dict[str, float] = {}
         self.times_us: Dict[str, float] = defaultdict(float)
         self.counters: Dict[str, int] = defaultdict(int)
+        self._tracer = None
+        # paired wall/monotonic anchors, taken back to back: perf_counter is
+        # not comparable across processes, so every timestamp this registry
+        # emits carries an epoch-relative twin — the alignment key merged
+        # multi-rank timelines sort by (observability/timeline.py)
+        self._mono0 = time.perf_counter()
         self.meta: Dict[str, object] = {
             "host": socket.gethostname(),
             "node": node_id,
             "nodes": num_nodes,
+            "epoch_s": time.time(),
         }
+
+    # ------------------------------------------------------------ span tracer
+    def attach_tracer(self, tracer=None, **tags):
+        """Attach (or build) an observability.SpanTracer sharing this
+        registry's clock anchors: every ``start``/``stop`` pair then mirrors
+        into a timeline span and every :meth:`event` into an instant event.
+        Returns the tracer."""
+        if tracer is None:
+            from tpu_radix_join.observability.spans import SpanTracer
+            tracer = SpanTracer(rank=self.node_id, tags=tags,
+                                epoch_s=self.meta["epoch_s"],
+                                mono_s=self._mono0)
+        self._tracer = tracer
+        return tracer
+
+    @property
+    def tracer(self):
+        return self._tracer
+
+    def set_trace_tags(self, **tags) -> None:
+        """Stamp tags (plan strategy, engine, ...) onto future spans; a
+        no-op without an attached tracer."""
+        if self._tracer is not None:
+            self._tracer.set_tags(**tags)
+
+    def span(self, name: str, **args):
+        """Timeline-only span context (grid pairs, checkpoint writes):
+        shows on the trace without minting a ``times_us`` tag per instance
+        — per-pair tags would make .perf files unbounded."""
+        if self._tracer is None:
+            import contextlib
+            return contextlib.nullcontext()
+        return self._tracer.span(name, **args)
 
     # ----------------------------------------------------------------- timers
     def start(self, key: str) -> None:
         self._starts[key] = time.perf_counter()
+        if self._tracer is not None:
+            self._tracer.begin(key)
 
     def stop(self, key: str, fence=None) -> float:
         """Stop a timer; ``fence`` (any pytree of jax arrays) is
@@ -107,6 +149,11 @@ class Measurements:
             jax.block_until_ready(fence)
         dt = (time.perf_counter() - self._starts.pop(key)) * 1e6
         self.times_us[key] += dt
+        if self._tracer is not None:
+            # the span records the real wall interval; exclude_from_running
+            # shifts only the accumulated column (a compile excluded from
+            # JTOTAL still happened on the timeline, under its own span)
+            self._tracer.end(key)
         return dt
 
     def add_time_us(self, key: str, us: float) -> None:
@@ -129,10 +176,21 @@ class Measurements:
         ``<rank>.info`` JSON).  The robustness layer records faults fired,
         retries taken, and checkpoints written here so a post-mortem can
         reconstruct the failure/recovery timeline without logs; values must
-        be JSON-serializable."""
+        be JSON-serializable.
+
+        Timestamps: ``t_s`` is this process's raw monotonic clock (kept for
+        artifact compatibility, NOT comparable across processes) and
+        ``t_epoch_s`` its wall-clock twin via the init-time anchor pair —
+        the field merged multi-rank timelines align on."""
+        now = time.perf_counter()
         events = self.meta.setdefault("events", [])
         events.append({"event": name,
-                       "t_s": round(time.perf_counter(), 6), **data})
+                       "t_s": round(now, 6),
+                       "t_epoch_s": round(
+                           self.meta["epoch_s"] + (now - self._mono0), 6),
+                       **data})
+        if self._tracer is not None:
+            self._tracer.instant(name, **data)
 
     # ----------------------------------------------------- detail accumulators
     def record_exchange(self, num_nodes: int, cap_r: int, cap_s: int,
@@ -271,6 +329,20 @@ class Measurements:
                 **{k: float(v) for k, v in self.counters.items()}}
 
     # ----------------------------------------------------------- aggregation
+    def _slim_meta(self) -> Dict[str, object]:
+        """Truncated stand-in for an oversized meta in :meth:`gather_all`:
+        never fail the report of an already-successful join over big
+        metadata — drop the bulk but preserve the fields the aggregate
+        report and timeline merge read (a truncated rank must not silently
+        vanish from the [RESULTS] FailureClasses line)."""
+        slim: Dict[str, object] = {"truncated": True}
+        for k in ("failure_class", "epoch_s"):
+            if k in self.meta:
+                slim[k] = self.meta[k]
+        if isinstance(self.meta.get("events"), list):
+            slim["events_count"] = len(self.meta["events"])
+        return slim
+
     def gather_all(self) -> List["Measurements"]:
         """Network gather of every process's registry — the analog of the
         reference's rank-0 result gather over MPI_Send/Recv
@@ -296,9 +368,7 @@ class Measurements:
         payload = json.dumps(rec, default=str).encode()
         cap = _GATHER_BUF_BYTES - 4
         if len(payload) > cap:
-            # never fail the report of an already-successful join over
-            # oversized metadata: drop meta first, keep the measurements
-            rec["meta"] = {"truncated": True}
+            rec["meta"] = self._slim_meta()
             payload = json.dumps(rec, default=str).encode()
         if len(payload) > cap:
             raise ValueError(

@@ -149,9 +149,14 @@ def find_xplane_files(trace_dir: str) -> List[str]:
         os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True))
 
 
-def _is_device_plane(name: str) -> bool:
+def is_device_plane(name: str) -> bool:
+    """Whether an XPlane name denotes an accelerator (vs host) plane —
+    the observability timeline uses this to pick the device track."""
     n = name.lower()
     return n.startswith("/device:") or "tpu" in n or "gpu" in n
+
+
+_is_device_plane = is_device_plane
 
 
 def summarize_trace(trace_dir: str) -> Optional[dict]:

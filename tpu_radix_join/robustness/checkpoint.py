@@ -32,6 +32,7 @@ resume (missing files count neither).
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Optional
@@ -55,6 +56,10 @@ class CheckpointManager:
         self.fingerprint = fingerprint
         self.measurements = measurements
 
+    def _span(self, name: str):
+        m = self.measurements
+        return m.span(name) if m is not None else contextlib.nullcontext()
+
     def load(self) -> Optional[dict]:
         """The saved state dict (including ``done``), or None when there is
         nothing valid to resume from.  Raises :class:`CheckpointMismatch` on
@@ -63,10 +68,11 @@ class CheckpointManager:
         if not os.path.exists(self.path):
             return None
         try:
-            _faults.check(_faults.CKPT_LOAD, m)
-            with open(self.path) as f:
-                state = json.load(f)
-            saved_fp = state.pop("fingerprint")
+            with self._span("ckpt_load"):
+                _faults.check(_faults.CKPT_LOAD, m)
+                with open(self.path) as f:
+                    state = json.load(f)
+                saved_fp = state.pop("fingerprint")
         except (json.JSONDecodeError, KeyError, OSError) as e:
             # truncated/corrupt checkpoint: restart from zero rather than
             # wedging every rerun on an unreadable file
@@ -91,13 +97,14 @@ class CheckpointManager:
         m = self.measurements
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
-            _faults.check(_faults.CKPT_SAVE, m)
-            with open(tmp, "w") as f:
-                json.dump({**state, "done": done,
-                           "fingerprint": self.fingerprint}, f)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self.path)
+            with self._span("ckpt_save"):
+                _faults.check(_faults.CKPT_SAVE, m)
+                with open(tmp, "w") as f:
+                    json.dump({**state, "done": done,
+                               "fingerprint": self.fingerprint}, f)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self.path)
         except OSError as e:
             if m is not None:
                 m.event("checkpoint_save_failed", path=self.path,
