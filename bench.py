@@ -186,11 +186,61 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
     return min_traffic_bytes / sort_s / 1e9, src
 
 
+def _run_chaos(runs, base_seed=0):
+    """``--chaos N``: CPU soak of N seeded fault schedules with verification
+    on.  Prints one outcome line per run and a JSON summary; a violating
+    schedule is shrunk to a minimal repro written under artifacts/chaos/.
+    Exit 0 iff no violations."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+    from tpu_radix_join.robustness import chaos
+
+    def show(out):
+        cls = f" class={out.failure_class}" if out.failure_class else ""
+        print(f"[CHAOS] seed={out.schedule.seed} {out.status}{cls} "
+              f"arms={[s for s, _ in out.schedule.arms]}")
+
+    runner = chaos.ChaosRunner(verify="check")
+    outcomes, summary = chaos.soak(runs, base_seed=base_seed, runner=runner,
+                                   on_outcome=show)
+    for out in outcomes:
+        if out.status != chaos.VIOLATION:
+            continue
+        shrunk = chaos.shrink(
+            out.schedule,
+            lambda s: runner.run(s).status == chaos.VIOLATION)
+        repro = runner.run(shrunk)
+        here = os.path.dirname(os.path.abspath(__file__))
+        rdir = os.path.join(here, "artifacts", "chaos")
+        os.makedirs(rdir, exist_ok=True)
+        path = os.path.join(rdir, f"repro_seed{shrunk.seed}.json")
+        print("[CHAOS] repro " + chaos.write_repro(repro, path))
+        print(f"[CHAOS] repro written to {path}")
+    print("[CHAOS] " + json.dumps(summary, sort_keys=True))
+    return 0 if summary["violations"] == 0 else 1
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
     check_baseline = None
     argv = sys.argv[1:]
+    if "--chaos" in argv:
+        # chaos soak mode (robustness/chaos.py): N seeded fault schedules
+        # with verification always on, every run must pass or classify;
+        # a violating schedule is ddmin-shrunk to a minimal (seed, arms)
+        # repro.  CPU-sized and exits before the chip-reservation
+        # machinery — it validates failure semantics, not throughput.
+        i = argv.index("--chaos")
+        try:
+            runs = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("error: --chaos needs an integer run count",
+                  file=sys.stderr)
+            sys.exit(2)
+        base_seed = (int(argv[argv.index("--chaos-seed") + 1])
+                     if "--chaos-seed" in argv else 0)
+        sys.exit(_run_chaos(runs, base_seed=base_seed))
     if "--check-regress" in argv:
         i = argv.index("--check-regress")
         if i + 1 >= len(argv):

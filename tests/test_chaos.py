@@ -1,0 +1,108 @@
+"""Tier-1 coverage for the chaos/soak harness (robustness/chaos.py): the
+fixed-seed mini-soak invariant (every run passes or fails classified),
+schedule determinism and JSON round-trips, and delta-debug shrinking of a
+violating schedule to a minimal replayable ``(seed, arms)`` repro.  The
+larger randomized soak rides behind ``-m slow``."""
+
+import json
+
+import pytest
+
+from tpu_radix_join.robustness import chaos, faults
+
+SOAK_RUNS = 25
+SOAK_SEED = 100
+
+
+@pytest.fixture(scope="module")
+def runner():
+    """One cached engine for the whole module: per-test construction would
+    recompile the pipeline for every case."""
+    return chaos.ChaosRunner(num_nodes=4, size=1 << 12, verify="check")
+
+
+def test_generate_schedule_deterministic_and_bounded():
+    a = chaos.generate_schedule(42)
+    assert a == chaos.generate_schedule(42)
+    assert a != chaos.generate_schedule(43)
+    assert 1 <= len(a.arms) <= len(chaos.CHAOS_SITES)
+    assert all(site in chaos.CHAOS_SITES for site, _ in a.arms)
+
+
+def test_schedule_json_round_trip():
+    sched = chaos.generate_schedule(7)
+    again = chaos.Schedule.from_json(
+        json.loads(json.dumps(sched.to_json())))
+    assert again == sched
+
+
+def test_mini_soak_invariant_holds(runner):
+    """The tentpole acceptance gate: 25 fixed-seed schedules, every run
+    passes or fails with a named failure class — zero violations."""
+    outcomes, summary = chaos.soak(SOAK_RUNS, base_seed=SOAK_SEED,
+                                   runner=runner)
+    assert summary["violations"] == 0, [
+        o.to_json() for o in outcomes if o.status == chaos.VIOLATION]
+    assert summary["pass"] + summary["classified"] == SOAK_RUNS
+    # the schedule pool actually exercises every chaos failure mode
+    assert "data_corruption" in summary["failure_classes"]
+    assert "capacity_overflow" in summary["failure_classes"]
+    assert "device_unavailable" in summary["failure_classes"]
+
+
+def test_soak_outcomes_replay(runner):
+    """(seed, arms) is the repro: re-running any schedule reproduces the
+    same status, class, and count."""
+    first, _ = chaos.soak(3, base_seed=SOAK_SEED, runner=runner)
+    for out in first:
+        again = runner.run(out.schedule)
+        assert (again.status, again.failure_class, again.matches) == \
+            (out.status, out.failure_class, out.matches)
+
+
+def test_shrink_violating_schedule_to_minimal_repro():
+    """An unprotected (verify=off) engine turns the corruption arm into a
+    genuine silent-wrong-count violation; shrink must strip the inert arm
+    and the minimal schedule must replay deterministically."""
+    unprotected = chaos.ChaosRunner(num_nodes=4, size=1 << 12, verify="off")
+
+    def violates(s):
+        return unprotected.run(s).status == chaos.VIOLATION
+
+    sched = chaos.Schedule(seed=11, arms=(
+        (faults.EXCHANGE_CORRUPT, (("at", 1),)),
+        (faults.SHUFFLE_OVERFLOW, (("at", 2),)),   # never consulted twice
+    ))
+    shrunk = chaos.shrink(sched, violates)
+    assert len(shrunk.arms) == 1
+    assert shrunk.arms[0][0] == faults.EXCHANGE_CORRUPT
+    a, b = unprotected.run(shrunk), unprotected.run(shrunk)
+    assert a.status == b.status == chaos.VIOLATION
+    assert a.matches == b.matches != unprotected.oracle
+    assert "silent wrong count" in a.detail
+
+
+def test_shrink_requires_violation(runner):
+    clean = chaos.Schedule(seed=0, arms=())
+    with pytest.raises(ValueError, match="violating"):
+        chaos.shrink(clean, lambda s: False)
+
+
+def test_write_repro_round_trips(tmp_path, runner):
+    out = runner.run(chaos.generate_schedule(SOAK_SEED))
+    path = tmp_path / "repro.json"
+    line = chaos.write_repro(out, path)
+    obj = json.loads(path.read_text())
+    assert json.loads(line) == obj
+    assert chaos.Schedule.from_json(obj["schedule"]) == out.schedule
+
+
+@pytest.mark.slow
+def test_randomized_soak_long():
+    """Full soak: a wider randomized seed range across both verify modes.
+    Excluded from tier-1 (-m 'not slow'); run explicitly before releases."""
+    for verify in ("check", "repair"):
+        runner = chaos.ChaosRunner(num_nodes=4, size=1 << 12, verify=verify)
+        outcomes, summary = chaos.soak(100, base_seed=1000, runner=runner)
+        assert summary["violations"] == 0, [
+            o.to_json() for o in outcomes if o.status == chaos.VIOLATION]

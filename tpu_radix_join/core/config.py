@@ -130,6 +130,19 @@ class JoinConfig:
     # on-device generation.
     generation: str = "auto"
 
+    # --- integrity verification (robustness/verify.py) -------------------------
+    # End-to-end per-partition integrity checksums (count + sum + xor-fold of
+    # key lanes), computed over the pristine inputs before the exchange and
+    # re-derived from the pipeline after exchange / after local sort:
+    #   "off"    — no checksums (production default; zero overhead).
+    #   "check"  — mismatch => ok=False, failure_class="data_corruption"
+    #              (VFAIL counter + a data_corruption event).
+    #   "repair" — mismatch => recompute only the damaged network partitions
+    #              from the retained pristine inputs via the chunked grid
+    #              machinery (VREPAIR counter + grid_pair spans), then return
+    #              a corrected ok=True result.
+    verify: str = "off"
+
     # --- instrumentation -------------------------------------------------------
     debug_checks: bool = False   # runtime conservation invariants (JOIN_ASSERT analog)
     # Phase-split timing (Measurements.cpp:139-141 JMPI/JPROC columns): run
@@ -199,6 +212,15 @@ class JoinConfig:
             raise ValueError(
                 "chunk_size requires the sort probe (chunking bounds the "
                 "probe working set; the bucketized path is already blocked)")
+        if self.verify not in ("off", "check", "repair"):
+            raise ValueError(f"unknown verify mode {self.verify!r}")
+        if self.verify != "off" and self.measure_phases:
+            raise ValueError(
+                "verify does not compose with measure_phases: the split "
+                "driver consumes the shuffle program's outputs positionally "
+                "(operators/hash_join._run_split) and cannot carry the "
+                "checksum outputs through the phase boundary — use the "
+                "fused pipeline (measure_phases=False) for verified runs")
 
     # --- derived geometry ------------------------------------------------------
     @property

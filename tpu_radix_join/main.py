@@ -52,6 +52,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="after max-retries capacity doublings still "
                         "overflow: 'chunked' degrades to the out-of-core "
                         "count instead of returning ok=False")
+    p.add_argument("--verify", choices=["off", "check", "repair"],
+                   default="off",
+                   help="end-to-end integrity verification (robustness/"
+                        "verify.py): per-partition count/sum/xor checksums "
+                        "of the key lanes, computed before the exchange and "
+                        "re-derived after it (and after the local radix "
+                        "pass on the bucket path).  'check' fails a "
+                        "mismatched join with failure_class="
+                        "data_corruption; 'repair' recomputes only the "
+                        "damaged partitions out-of-core and returns the "
+                        "corrected count (VREPAIR counter)")
     p.add_argument("--cpu-fallback", action="store_true",
                    help="if device/mesh init fails, rebuild the engine over "
                         "host CPU devices (loud [DEGRADE] warning) instead "
@@ -178,13 +189,29 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
               if args.max_retries else None)
     meas.set_trace_tags(strategy="chunked_grid", engine="chunked")
     meas.start("JTOTAL")
-    total = chunked_join_grid(
-        stream_chunks_device(inner, 0, chunk),
-        lambda: stream_chunks_device(outer, 0, chunk),
-        min(chunk, 1 << 20),
-        checkpoint_path=ckpt_path, checkpoint_tag=tag,
-        progress=True, key_range=args.key_range, measurements=meas,
-        retry_policy=policy, plan=plan)
+    try:
+        total = chunked_join_grid(
+            stream_chunks_device(inner, 0, chunk),
+            lambda: stream_chunks_device(outer, 0, chunk),
+            min(chunk, 1 << 20),
+            checkpoint_path=ckpt_path, checkpoint_tag=tag,
+            progress=True, key_range=args.key_range, measurements=meas,
+            retry_policy=policy, plan=plan)
+    except Exception as e:
+        # a classified failure (e.g. DataCorruption from a key lane in the
+        # sentinel range — the streamed-lane corruption signature) exits
+        # with the machine-readable class instead of a bare traceback
+        cls = getattr(e, "failure_class", None)
+        if cls is None:
+            raise
+        meas.stop("JTOTAL")
+        meas.meta["failure_class"] = cls
+        print(f"[RESULTS] failure/failure_class: {cls}")
+        print(f"[RESULTS] failure/error: {e}", file=sys.stderr)
+        if args.output_dir:
+            path = meas.store(args.output_dir)
+            print(f"[PERF] stored {path}")
+        return 1
     meas.stop("JTOTAL")
     print(f"[RESULTS] Tuples: {total}")
     if expected is not None:
@@ -246,6 +273,7 @@ def main(argv=None) -> int:
         generation=args.generation,
         debug_checks=args.debug_checks,
         measure_phases=args.measure_phases,
+        verify=args.verify,
     )
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
@@ -419,6 +447,10 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
     # rank's own .info file
     meas.meta["failure_class"] = (result.diagnostics or {}).get(
         "failure_class", "ok" if result.ok else "unknown")
+    # per-site fault-injection accounting (hits/fired, faults.site_stats):
+    # rides into the rank-0 FaultSites aggregate next to FailureClasses
+    if (result.diagnostics or {}).get("fault_sites"):
+        meas.meta["fault_sites"] = result.diagnostics["fault_sites"]
     if args.repeat > 1:
         # RESULTS accumulates per join; the report's "Tuples" line means THE
         # join's result count.  Times/tuple counters stay cumulative (JRATE

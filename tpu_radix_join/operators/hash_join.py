@@ -37,6 +37,8 @@ from tpu_radix_join.data.tuples import (
     R_PAD_KEY,
     TupleBatch,
     _sentinel_lane,
+    partition_ids,
+    valid_mask,
 )
 from tpu_radix_join.histograms import (
     compute_global_histogram,
@@ -63,10 +65,13 @@ from tpu_radix_join.operators import skew
 from tpu_radix_join.operators.local_partitioning import local_partition
 from tpu_radix_join.ops.radix import local_histogram, scatter_to_blocks
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
-from tpu_radix_join.parallel.network_partitioning import network_partition
+from tpu_radix_join.parallel.network_partitioning import (network_partition,
+                                                          receive_checksums)
 from tpu_radix_join.parallel.window import ExchangeResult, Window
-from tpu_radix_join.performance.measurements import BACKOFFMS, RETRYN
+from tpu_radix_join.performance.measurements import (BACKOFFMS, RETRYN, VCHK,
+                                                     VCHKN, VFAIL, VREPAIR)
 from tpu_radix_join.robustness import faults as _faults
+from tpu_radix_join.robustness import verify as _verify
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
                                              RETRIES_EXHAUSTED, RetryPolicy,
                                              classify_diagnostics)
@@ -381,7 +386,7 @@ class HashJoin:
 
     def _pipeline_fn(self, local_size_r: int, local_size_s: int,
                      cap_r: int, cap_s: int, local_slack: int = 1,
-                     skew_plan=None):
+                     skew_plan=None, verify: bool = False):
         cfg = self.config
         ax = cfg.mesh_axes
         n = cfg.num_nodes
@@ -443,9 +448,11 @@ class HashJoin:
              s_gh) = self._shuffle(r, s, win_r, win_s, skew_plan)
 
             # ---- Phase 5/6: local processing (HashJoin.cpp:131-204) ----
-            counts, local_overflow, count_risk = self._local_process(
-                rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, hot_batch,
-                cap_r, cap_s, local_slack, s_hist_bound=s_gh)
+            counts, local_overflow, count_risk, sort_checks = \
+                self._local_process(
+                    rp.batch, rp.valid, sp.batch, sp.valid, sp.pid, hot_batch,
+                    cap_r, cap_s, local_slack, s_hist_bound=s_gh,
+                    checksum_axis=ax if verify else None)
 
             # Failure breakdown, globally reduced (SURVEY.md section 5.3: the
             # reference aborts on any failure; here every mode is counted so
@@ -462,13 +469,24 @@ class HashJoin:
                 hot_overflow.astype(jnp.uint32),
                 jax.lax.psum(count_risk.astype(jnp.uint32), ax),
             ])
+            if verify:
+                # integrity fingerprints recomputed downstream of the
+                # exchange (robustness/verify.py): what each stage received,
+                # alternating R/S per set.  The host compares them against
+                # the pre-exchange fingerprints of what was sent.
+                vsets = [receive_checksums(rp, num_p, ax),
+                         receive_checksums(sp, num_p, ax)]
+                if sort_checks is not None:
+                    vsets.extend(sort_checks)
+                return counts, flags, jnp.stack(vsets)
             return counts, flags
 
         spec = P(ax)
+        out_specs = (spec, P(), P()) if verify else (spec, P())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh,
             in_specs=(spec, spec),
-            out_specs=(spec, P()),
+            out_specs=out_specs,
         ))
 
     def _shuffle_fn(self, cap_r: int, cap_s: int, skew_plan=None,
@@ -539,7 +557,7 @@ class HashJoin:
 
         def run(rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
                 s_gh):
-            counts, local_overflow, count_risk = self._local_process(
+            counts, local_overflow, count_risk, _ = self._local_process(
                 rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, hot_batch,
                 cap_r, cap_s, local_slack, s_hist_bound=s_gh)
             return (counts,
@@ -920,19 +938,29 @@ class HashJoin:
 
     def _local_process(self, rp_batch: TupleBatch, rp_valid, sp_batch: TupleBatch,
                        sp_valid, sp_pid, hot_batch, cap_r: int, cap_s: int,
-                       local_slack: int, s_hist_bound=None):
+                       local_slack: int, s_hist_bound=None,
+                       checksum_axis=None):
         """Phase 5/6 — local partitioning + build-probe on the received
         buffers (HashJoin.cpp:131-204).  Traced either inside the fused
         pipeline body or as its own shard_map program when the driver times
         JMPI/JPROC separately (``config.measure_phases``).  Returns
-        (per-partition counts, local overflow, count-overflow risk).
+        (per-partition counts, local overflow, count-overflow risk,
+        post-local-sort checksum sets or None).
 
         ``s_hist_bound``: global per-partition outer tuple counts for the
         overflow-risk bound — always the shuffle's s_ghist (free: the fused
         pipeline has it in scope; the split probe program receives the tiny
         [P] array as an input).  Required on the non-bucket paths; the
         bucket path bounds per-bucket counts from static capacities
-        instead."""
+        instead.
+
+        ``checksum_axis``: when set (config.verify), the bucket path also
+        fingerprints its re-partitioned blocks (robustness/verify.py) so a
+        tuple damaged by the local radix pass — not just the exchange — is
+        caught; skipped under a skew plan, where the replicated hot build
+        side makes the block contents incomparable with the pre-exchange
+        fingerprint.  The sort/chunked probes reorder nothing the caller
+        can observe, so only the bucket path has a third stage to check."""
         cfg = self.config
         ax = cfg.mesh_axes
         fanout = cfg.network_fanout_bits
@@ -954,7 +982,16 @@ class HashJoin:
                                  cfg.local_fanout_bits, lcap_s, "outer")
             counts, count_risk = self._bucket_probe(
                 lr.blocks, ls.blocks, lcap_r, lcap_s)
-            return counts, lr.overflow + ls.overflow, count_risk
+            sort_checks = None
+            if checksum_axis is not None and hot_batch is None:
+                sort_checks = [
+                    _verify.global_partition_checksums(
+                        blocks.key, partition_ids(blocks, fanout), num_p,
+                        checksum_axis, valid=valid_mask(blocks, side),
+                        key_hi=blocks.key_hi)
+                    for blocks, side in ((lr.blocks, "inner"),
+                                         (ls.blocks, "outer"))]
+            return counts, lr.overflow + ls.overflow, count_risk, sort_checks
         if s_hist_bound is None:
             raise ValueError(
                 "non-bucket local processing requires s_hist_bound (the "
@@ -986,7 +1023,7 @@ class HashJoin:
             counts, maxw = count(rk, sp_batch.key, fanout,
                                  return_max_weight=True)
         return (counts, jnp.uint32(0),
-                self._count_risk(maxw, s_hist_bound))
+                self._count_risk(maxw, s_hist_bound), None)
 
     def _shuffle(self, r: TupleBatch, s: TupleBatch,
                  win_r: Window, win_s: Window, skew_plan=None):
@@ -1161,7 +1198,7 @@ class HashJoin:
 
     def _get_compiled(self, r: TupleBatch, s: TupleBatch,
                       cap_r: int, cap_s: int, local_slack: int = 1,
-                      skew_plan=None):
+                      skew_plan=None, verify: bool = False):
         """AOT-compiled pipeline executable for these shapes/capacities.
 
         Ahead-of-time ``lower().compile()`` keeps XLA compilation out of the
@@ -1169,13 +1206,97 @@ class HashJoin:
         compilation — there is none at runtime)."""
         n = self.config.num_nodes
         key = (r.size // n, s.size // n, cap_r, cap_s, local_slack, skew_plan,
-               r.key_hi is None, s.key_hi is None, self._full_range,
+               r.key_hi is None, s.key_hi is None, self._full_range, verify,
                getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         return self._compile_timed(
             key,
             lambda: self._pipeline_fn(r.size // n, s.size // n, cap_r, cap_s,
-                                      local_slack,
-                                      skew_plan).lower(r, s).compile())
+                                      local_slack, skew_plan,
+                                      verify=verify).lower(r, s).compile())
+
+    # --------------------------------------------------- integrity verify
+    def _verify_pre_fn(self, hot_bits: int):
+        """Pre-exchange fingerprint program: ``[2, rows, P]`` (R then S)
+        global checksums of the pristine inputs (robustness/verify.py).
+        Runs as its own tiny program *before* the pipeline dispatch so the
+        fingerprint captures what was sent, not what arrived.  Under a skew
+        plan hot R partitions are excluded — they leave the shuffle for the
+        replication route and have no post-exchange counterpart; hot S
+        spreads but still lands in the receive buffers with its true pid,
+        so S fingerprints all tuples."""
+        cfg = self.config
+        ax = cfg.mesh_axes
+        fanout = cfg.network_fanout_bits
+        num_p = cfg.network_partition_count
+
+        def body(r: TupleBatch, s: TupleBatch):
+            r_pid = partition_ids(r, fanout)
+            s_pid = partition_ids(s, fanout)
+            r_valid = ~skew.is_hot(r_pid, hot_bits) if hot_bits else None
+            return jnp.stack([
+                _verify.global_partition_checksums(
+                    r.key, r_pid, num_p, ax, valid=r_valid, key_hi=r.key_hi),
+                _verify.global_partition_checksums(
+                    s.key, s_pid, num_p, ax, key_hi=s.key_hi),
+            ])
+
+        spec = P(ax)
+        return jax.jit(jax.shard_map(
+            body, mesh=self.mesh, in_specs=(spec, spec), out_specs=P()))
+
+    def _run_verify_pre(self, r: TupleBatch, s: TupleBatch, skew_plan):
+        """Compile + execute the pre-exchange fingerprint program, timed
+        under VCHK (the tag tools_check_regress.py gates the verification
+        overhead on)."""
+        m = self.measurements
+        n = self.config.num_nodes
+        hot_bits = skew_plan[0] if skew_plan else 0
+        key = ("vpre", hot_bits, r.size // n, s.size // n,
+               r.key_hi is None, s.key_hi is None,
+               getattr(r.key, "sharding", None),
+               getattr(s.key, "sharding", None))
+        fn = self._compile_timed(
+            key, lambda: self._verify_pre_fn(hot_bits).lower(r, s).compile())
+        if m:
+            m.start(VCHK)
+        pre = fn(r, s)
+        if m:
+            m.stop(VCHK, fence=pre)
+        return pre
+
+    def _inject_exchange_corrupt(self, s: TupleBatch):
+        """Fault site ``exchange.corrupt_lane``: flip bit 30 of one outer
+        key between the pre-exchange fingerprint and the pipeline dispatch
+        — the in-flight bit-flip the integrity checksums exist to catch.
+        Bit 30 keeps the damaged key inside the key contract (below the
+        31-bit merge packing and both pad sentinels) and above the radix
+        bits, so the tuple still routes to its original partition: counts
+        conserve, flags stay clean, and only the checksum comparison can
+        see the damage.  Returns ``(batch for the pipeline, pristine batch
+        or None)`` — the pristine copy is the repair source."""
+        if not _faults.fires(_faults.EXCHANGE_CORRUPT, self.measurements):
+            return s, None
+        if not getattr(s.key, "is_fully_addressable", True):
+            return s, None   # multi-process shards: cannot mutate host-side
+        sk = np.asarray(s.key).copy()
+        sk[0] ^= np.uint32(0x40000000)
+        # keep an explicit mesh layout; a host-built array stays uncommitted
+        # (shard_map lays it out), since device_put with its single-device
+        # sharding would pin it and break the mesh dispatch
+        sharding = getattr(s.key, "sharding", None)
+        key = (jax.device_put(sk, sharding)
+               if isinstance(sharding, NamedSharding) else jnp.asarray(sk))
+        return TupleBatch(key=key, rid=s.rid, key_hi=s.key_hi), s
+
+    @staticmethod
+    def _stamp_fault_sites(diag: Optional[dict]) -> Optional[dict]:
+        """Record the active injector's per-site hit/fire accounting in the
+        result diagnostics (the FaultSites aggregate print_results reports
+        next to FailureClasses).  No-op in production (no injector)."""
+        inj = _faults.active()
+        if inj is not None and diag is not None:
+            diag["fault_sites"] = inj.site_stats()
+        return diag
 
     @staticmethod
     def _to_host(x) -> np.ndarray:
@@ -1367,23 +1488,44 @@ class HashJoin:
                 r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
+        # integrity verification (robustness/verify.py): fingerprint the
+        # pristine inputs before anything can damage them.  The n==1 sort
+        # specialization performs no exchange (nothing to verify against)
+        # and is skipped entirely.
+        verify_on = (self.config.verify != "off"
+                     and not self._single_node_sort_probe())
+        pre = self._run_verify_pre(r, s, skew_plan) if verify_on else None
+        # host-side corruption site, consulted between the pre-exchange
+        # fingerprint and the pipeline dispatch — and regardless of the
+        # verify mode: real corruption does not ask whether anyone is
+        # checking (verify="off" + this site armed IS the silent-wrong-
+        # answer scenario the chaos soak hunts)
+        s, pristine_s = self._inject_exchange_corrupt(s)
         if repeats > 1:
             # amortized-dispatch mode: one compiled program, ``repeats``
             # async dispatches, one fence; flags read once (identical
             # static shapes make every attempt fail or succeed alike)
             fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
-                                    skew_plan)
+                                    skew_plan, verify=verify_on)
             if m:
                 m.start("JPROC")
-            counts = flags = None
+            counts = flags = vchk = None
             for _ in range(repeats):
-                counts, flags = fn(r, s)
+                if verify_on:
+                    counts, flags, vchk = fn(r, s)
+                else:
+                    counts, flags = fn(r, s)
             if m:
                 m.stop("JPROC", fence=(counts, flags))
             flags = np.asarray(flags)
             diag = self._flags_to_diag(flags)
-            result = self._finish_join(r, s, counts, flags, diag,
-                                       cap_r, cap_s, repeats)
+            if verify_on and not flags.any():
+                result = self._verified_finish(
+                    r, s, pristine_s, counts, flags, diag, pre, vchk,
+                    cap_r, cap_s, skew_plan, repeats)
+            else:
+                result = self._finish_join(r, s, counts, flags, diag,
+                                           cap_r, cap_s, repeats)
             self._cache_store_capacities(r, s, cap_r, cap_s, local_slack,
                                          result.ok)
             return result
@@ -1391,16 +1533,22 @@ class HashJoin:
         # user still gets two separate programs); only the host timers need m
         use_split = (self.config.measure_phases
                      and not self._single_node_sort_probe())
+        vchk = None
         for attempt in range(self.config.max_retries + 1):
             if use_split:
+                # config.__post_init__ rejects verify + measure_phases, so
+                # verify_on is always False on this branch
                 counts, flags, dts = self._run_split(
                     r, s, cap_r, cap_s, local_slack, skew_plan)
             else:
                 fn = self._get_compiled(r, s, cap_r, cap_s, local_slack,
-                                        skew_plan)
+                                        skew_plan, verify=verify_on)
                 if m:
                     m.start("JPROC")
-                counts, flags = fn(r, s)
+                if verify_on:
+                    counts, flags, vchk = fn(r, s)
+                else:
+                    counts, flags = fn(r, s)
                 dts = ({"JPROC": m.stop("JPROC", fence=(counts, flags))}
                        if m else {})
             flags = self._inject_shuffle_fault(np.asarray(flags))
@@ -1427,7 +1575,17 @@ class HashJoin:
             # retries exhausted on a retryable (capacity) failure: degrade
             # to the out-of-core grid path instead of returning ok=False
             return self._fallback_chunked(r, s, diag, cap_r, cap_s)
-        result = self._finish_join(r, s, counts, flags, diag, cap_r, cap_s, 1)
+        if verify_on and not flags.any():
+            # checksum comparison only judges the accepted attempt, and only
+            # when its flags are clean: a capacity shortfall legitimately
+            # drops tuples (its own failure class), and fatal flags already
+            # fail the join without verification's help
+            result = self._verified_finish(r, s, pristine_s, counts, flags,
+                                           diag, pre, vchk, cap_r, cap_s,
+                                           skew_plan, 1)
+        else:
+            result = self._finish_join(r, s, counts, flags, diag, cap_r,
+                                       cap_s, 1)
         self._cache_store_capacities(r, s, cap_r, cap_s, local_slack,
                                      result.ok)
         return result
@@ -1465,6 +1623,7 @@ class HashJoin:
         from tpu_radix_join.ops.chunked import chunked_join_count
         diag = dict(diag, failure_class=CAPACITY_OVERFLOW,
                     degraded="chunked")
+        self._stamp_fault_sites(diag)
         try:
             slab = min(1 << 20, s.size)
             matches = chunked_join_count(
@@ -1497,6 +1656,146 @@ class HashJoin:
                                                       np.uint32),
                           diagnostics=diag)
 
+    def _verified_finish(self, r: TupleBatch, s: TupleBatch,
+                         pristine_s: Optional[TupleBatch], counts, flags,
+                         diag: dict, pre, vchk, cap_r: int, cap_s: int,
+                         skew_plan, repeats: int) -> JoinResult:
+        """Integrity verdict on an accepted flag-clean attempt: compare the
+        pre-exchange fingerprints against every set the pipeline recomputed
+        (post-exchange always; post-local-sort on the bucket path), then
+        cross-check the reported counts against the per-partition
+        cross-product bound.  Intact -> the normal epilogue; damaged ->
+        ``data_corruption`` (check mode) or partition-granular recompute
+        (repair mode)."""
+        m = self.measurements
+        cfg = self.config
+        num_p = cfg.network_partition_count
+        if m:
+            m.start(VCHK)
+        pre_h = np.asarray(self._to_host(pre))
+        vchk_h = np.asarray(self._to_host(vchk))
+        damaged = set()
+        ncomp = 0
+        for k in range(vchk_h.shape[0]):
+            # sets alternate R/S (post-exchange pair, then the bucket
+            # path's post-local-sort pair) — each compares against its
+            # relation's pre-exchange fingerprint
+            ncomp += 1
+            damaged.update(int(p) for p in _verify.damaged_partitions(
+                pre_h[k % 2], vchk_h[k]))
+        counts_h = self._to_host(counts)
+        cross = None
+        if not damaged and not cfg.bucket_path and skew_plan is None:
+            # bucket-path counts are per local bucket and a skew plan
+            # replicates hot R (its pre fingerprint excludes those
+            # partitions) — the per-network-partition bound only means
+            # something on the plain sort/chunked layouts
+            ncomp += 1
+            cross = _verify.cross_check_counts(
+                counts_h.reshape(cfg.num_nodes, num_p),
+                int(counts_h.astype(np.uint64).sum()),
+                pre_h[0][0], pre_h[1][0])
+        if m:
+            m.stop(VCHK)
+            m.incr(VCHKN, ncomp)
+        if not damaged and cross is None:
+            return self._finish_join(r, s, counts_h, flags, diag, cap_r,
+                                     cap_s, repeats)
+        dmg = sorted(damaged)
+        if m:
+            m.incr(VFAIL)
+            m.event("data_corruption", partitions=dmg[:16],
+                    comparisons=ncomp, cross=cross)
+        diag = dict(diag, data_corruption_partitions=max(1, len(dmg)))
+        if cross is not None:
+            diag["data_corruption_cross"] = cross
+        diag["failure_class"] = classify_diagnostics(diag)
+        if cfg.verify != "repair":
+            result = self._finish_join(r, s, counts_h, flags, diag, cap_r,
+                                       cap_s, repeats)
+            return result._replace(ok=False)
+        return self._repair(r, pristine_s if pristine_s is not None else s,
+                            counts_h, diag, dmg, repeats)
+
+    def _repair(self, r: TupleBatch, s: TupleBatch, counts_h: np.ndarray,
+                diag: dict, dmg, repeats: int) -> JoinResult:
+        """``verify="repair"``: recompute only the damaged network
+        partitions from the pristine inputs and splice their counts back —
+        the degrade-not-fail discipline of _fallback_chunked, at partition
+        granularity.  The sort/chunked count layouts expose one column per
+        network partition, so intact columns are kept and each damaged
+        partition re-joins out-of-core as its own 1x1 grid (grid-pair
+        spans + GRIDPAIRS make the narrow scope observable); the bucket
+        layout can't be decomposed per network partition, so it recomputes
+        the whole join — still without failing it."""
+        m = self.measurements
+        cfg = self.config
+        num_p = cfg.network_partition_count
+        from tpu_radix_join.ops.chunked import (chunked_join_count,
+                                                chunked_join_grid)
+        rk = self._to_host(r.key)
+        sk = self._to_host(s.key)
+        rhi = None if r.key_hi is None else self._to_host(r.key_hi)
+        shi = None if s.key_hi is None else self._to_host(s.key_hi)
+        slab = min(1 << 20, max(1, s.size))
+        scope = "partition"
+        if cfg.bucket_path or not dmg:
+            # per-bucket counts (or a cross-check violation, which names no
+            # partition): full out-of-core recompute
+            scope = "full"
+            matches = chunked_join_count(
+                TupleBatch(key=jnp.asarray(rk), rid=r.rid,
+                           key_hi=None if rhi is None else jnp.asarray(rhi)),
+                TupleBatch(key=jnp.asarray(sk), rid=s.rid,
+                           key_hi=None if shi is None else jnp.asarray(shi)),
+                slab, key_range="auto")
+            counts_out = np.asarray([matches % (1 << 32)], np.uint32)
+        else:
+            cols = counts_h.reshape(cfg.num_nodes, num_p).astype(np.uint64)
+            for p in dmg:
+                cols[:, p] = 0
+            intact = int(cols.sum())
+            mask = np.uint32(num_p - 1)
+            total_repaired = 0
+            for p in dmg:
+                rsel = (rk & mask) == p
+                ssel = (sk & mask) == p
+                cnt = 0
+                if rsel.any() and ssel.any():
+                    cnt = chunked_join_grid(
+                        [TupleBatch(
+                            key=jnp.asarray(rk[rsel]),
+                            rid=jnp.zeros(int(rsel.sum()), jnp.uint32),
+                            key_hi=None if rhi is None
+                            else jnp.asarray(rhi[rsel]))],
+                        [TupleBatch(
+                            key=jnp.asarray(sk[ssel]),
+                            rid=jnp.zeros(int(ssel.sum()), jnp.uint32),
+                            key_hi=None if shi is None
+                            else jnp.asarray(shi[ssel]))],
+                        min(slab, int(ssel.sum())), measurements=m)
+                # the recomputed count has no per-device decomposition;
+                # park it in row 0 of its column (the uint64 total above
+                # is exact — partition_counts stays a uint32 view)
+                cols[0, p] = cnt % (1 << 32)
+                total_repaired += cnt
+            matches = intact + total_repaired
+            counts_out = cols.astype(np.uint32).reshape(counts_h.shape)
+        diag = dict(diag, repaired=scope,
+                    repaired_partitions=[int(p) for p in dmg])
+        self._stamp_fault_sites(diag)
+        if m:
+            m.incr(VREPAIR, max(1, len(dmg)))
+            m.event("repair", scope=scope,
+                    partitions=[int(p) for p in dmg][:16])
+            m.stop("JTOTAL")
+            m.incr("RESULTS", matches * repeats)
+            m.incr("RTUPLES", r.size * repeats)
+            m.incr("STUPLES", s.size * repeats)
+            m.derive_rates()
+        return JoinResult(matches=matches, ok=True,
+                          partition_counts=counts_out, diagnostics=diag)
+
     def _finish_join(self, r: TupleBatch, s: TupleBatch, counts, flags,
                      diag: dict, cap_r: int, cap_s: int,
                      repeats: int) -> JoinResult:
@@ -1504,6 +1803,7 @@ class HashJoin:
         per dispatched join — the reference counts its exchange in the hot
         loop per Put, Measurements.cpp:272-349), derived rates, result."""
         m = self.measurements
+        self._stamp_fault_sites(diag)
         counts = self._to_host(counts)
         matches = int(counts.astype(np.uint64).sum())
         if m:
@@ -1594,6 +1894,7 @@ class HashJoin:
             m.record_exchange(n, cap_r, cap_s,
                               tuple_bytes=8 if r.key_hi is None else 12)
             m.derive_rates()
+        self._stamp_fault_sites(diag)
         return MaterializedJoinResult(r_rid=r_rid, s_rid=s_rid,
                                       matches=int(valid.sum()),
                                       ok=not flags.any(), diagnostics=diag)

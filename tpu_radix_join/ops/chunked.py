@@ -136,10 +136,13 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
         if key_range == "auto":
             mx = int(np.asarray(jnp.maximum(jnp.max(r.key), jnp.max(s.key))))
             if mx >= int(pad_sentinel("inner")):
-                raise ValueError(
+                from tpu_radix_join.robustness.verify import DataCorruption
+                raise DataCorruption(
                     f"keys reach the pad sentinel range (max {mx:#x}): "
                     f"uint32 keys must stay <= "
-                    f"{int(pad_sentinel('inner')) - 1:#x}")
+                    f"{int(pad_sentinel('inner')) - 1:#x} — a key lane in "
+                    f"the sentinel range is the streamed-lane corruption "
+                    f"signature (such tuples would silently pad-match)")
             full = mx > MAX_MERGE_KEY
         if full:
             per_slab, maxw = _scan_probe_full(r.key, keys,

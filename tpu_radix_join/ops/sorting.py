@@ -31,3 +31,31 @@ def sort_lex_unstable(*operands: jnp.ndarray, num_keys: int,
     (remaining operands ride along as values)."""
     return jax.lax.sort(operands, num_keys=num_keys, dimension=dimension,
                         is_stable=False)
+
+
+def segmented_xor_fold(segment: jnp.ndarray, values: jnp.ndarray,
+                       num_segments: int) -> jnp.ndarray:
+    """Per-segment xor-fold: ``out[q] = XOR of values[i] where segment[i] == q``.
+
+    XLA has no scatter-xor, so the fold goes through the pipeline's native
+    reorder primitive instead: sort values by segment id, prefix-xor them
+    with an associative scan, then difference the prefix at consecutive
+    segment boundaries (located by searchsorted, which also handles empty
+    segments — their fold is 0).  Order-independence is inherited from xor
+    itself, so the unstable sort is safe.  Segments >= ``num_segments`` act
+    as a discard bucket (callers route invalid lanes there).
+
+    The integrity-verification checksums (robustness/verify.py) are the
+    consumer: xor catches the bit-flip corruptions that a wrapping uint32
+    sum can miss (paired flips cancel in addition far more easily than in
+    parity per bit position).
+    """
+    seg_s, val_s = sort_kv_unstable(segment.astype(jnp.uint32),
+                                    values.astype(jnp.uint32))
+    prefix = jax.lax.associative_scan(jnp.bitwise_xor, val_s)
+    # E[q] = prefix-xor through the last element with segment <= q
+    idx = jnp.searchsorted(seg_s, jnp.arange(num_segments, dtype=jnp.uint32),
+                           side="right") - 1
+    bounded = jnp.where(idx >= 0, prefix[jnp.clip(idx, 0)], jnp.uint32(0))
+    shifted = jnp.concatenate([jnp.zeros((1,), jnp.uint32), bounded[:-1]])
+    return bounded ^ shifted

@@ -66,3 +66,16 @@ def network_partition(
         batch=res.batch, valid=recv_valid, pid=recv_pid,
         recv_counts=res.recv_counts, send_overflow=res.send_overflow,
     )
+
+
+def receive_checksums(res: NetworkPartitionResult, num_partitions: int,
+                      axis) -> jnp.ndarray:
+    """Mesh-global ``[rows, P]`` integrity fingerprint of what the exchange
+    delivered (robustness/verify.py), traced inside the same shard_map as
+    the exchange itself.  Compared on the host against the pre-exchange
+    fingerprint of what was sent: equal rows == the shuffle conserved every
+    tuple and every key bit."""
+    from tpu_radix_join.robustness import verify as _verify
+    return _verify.global_partition_checksums(
+        res.batch.key, res.pid, num_partitions, axis,
+        valid=res.valid, key_hi=res.batch.key_hi)

@@ -67,6 +67,12 @@ CKPTSAVE = "CKPTSAVE"      # checkpoints written (robustness/checkpoint.py)
 CKPTLOAD = "CKPTLOAD"      # checkpoints resumed from
 GRIDPAIRS = "GRIDPAIRS"    # chunk pairs actually probed by chunked_join_grid
                            # (resume skips completed pairs — see ops/chunked.py)
+VCHK = "VCHK"              # integrity-verification timing tag (times_us ONLY:
+                           # summary() merges counters over times on a shared
+                           # key, so the check count lives under VCHKN)
+VCHKN = "VCHKN"            # integrity checksum comparisons performed
+VFAIL = "VFAIL"            # checksum mismatches detected (robustness/verify.py)
+VREPAIR = "VREPAIR"        # damaged partitions recomputed under --verify repair
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second
@@ -448,6 +454,21 @@ def print_results(measurements: Iterable[Measurements],
                   f"ranks not ok — {per_rank}", file=file)
         else:
             print(f"[RESULTS] FailureClasses: ok x{len(classes)}", file=file)
+    # per-site fault-injection accounting (faults.FaultInjector.site_stats,
+    # stamped into meta as "fault_sites" by main.py / the chaos runner): a
+    # soak report must show which sites were exercised, not just that
+    # FINJECT ticked.  Summed across ranks.
+    sites: Dict[str, Dict[str, int]] = {}
+    for m in ms:
+        for site, st in (m.meta.get("fault_sites") or {}).items():
+            acc = sites.setdefault(site, {"hits": 0, "fired": 0})
+            acc["hits"] += int(st.get("hits", 0))
+            acc["fired"] += int(st.get("fired", 0))
+    if sites:
+        per_site = " ".join(
+            f"{site}={st['fired']}/{st['hits']}"
+            for site, st in sorted(sites.items()))
+        print(f"[RESULTS] FaultSites (fired/hits): {per_site}", file=file)
     for k in keys:
         unit = "us" if any(k in m.times_us for m in ms) else "count"
         print(f"[RESULTS] {k}: max {agg[k]['max']:.0f} {unit}, "

@@ -13,6 +13,16 @@ and a test:
     failure-class taxonomy derived from ``JoinResult.diagnostics``.
   * :mod:`~tpu_radix_join.robustness.checkpoint` — atomic slab-boundary
     checkpoint/resume for out-of-core grid joins.
+  * :mod:`~tpu_radix_join.robustness.verify` — end-to-end data-integrity
+    verification: order-independent per-partition checksums (count / key
+    sum / key xor-fold) compared across pipeline stages, the
+    ``data_corruption`` failure class, and the fingerprint primitives the
+    engine's ``--verify`` modes build on.
+  * :mod:`~tpu_radix_join.robustness.chaos` — seeded chaos/soak harness:
+    randomized fault schedules over the :data:`faults.SITES` vocabulary,
+    a pass-or-classified invariant over N runs, and delta-debugging
+    shrink of violating schedules to minimal replayable repros.  Imported
+    lazily by callers, not here: it pulls in the full engine stack.
   * :mod:`~tpu_radix_join.robustness.degrade` — graceful degradation
     (accelerator-init failure -> CPU engine).  Imported lazily by callers,
     not here: it pulls in the full engine stack.
@@ -21,13 +31,17 @@ and a test:
 from tpu_radix_join.robustness import faults
 from tpu_radix_join.robustness.checkpoint import (CheckpointManager,
                                                   CheckpointMismatch)
-from tpu_radix_join.robustness.retry import (RetriesExhausted, RetryPolicy,
+from tpu_radix_join.robustness.retry import (DATA_CORRUPTION,
+                                             RetriesExhausted, RetryPolicy,
                                              classify_diagnostics, execute)
+from tpu_radix_join.robustness.verify import DataCorruption
 
 __all__ = [
     "faults",
     "CheckpointManager",
     "CheckpointMismatch",
+    "DataCorruption",
+    "DATA_CORRUPTION",
     "RetryPolicy",
     "RetriesExhausted",
     "classify_diagnostics",

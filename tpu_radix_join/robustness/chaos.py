@@ -1,0 +1,260 @@
+"""Seeded chaos/soak harness with shrinking fault-schedule repros.
+
+The soak invariant this module enforces end to end: **every join run,
+under any schedule of injected faults, either passes verification or
+terminates with a classified failure** (``diagnostics["failure_class"]``
+or an exception carrying one).  A run that returns ``ok=True`` with a
+wrong count, or dies with an unclassified exception, is a VIOLATION —
+the silent-corruption outcome the integrity checksums
+(robustness/verify.py) exist to rule out.
+
+Pieces:
+
+  * :func:`generate_schedule` — a seeded schedule of fault arms drawn from
+    the :data:`CHAOS_SITES` subset of :data:`faults.SITES` (the sites the
+    array-join path actually consults; arming the grid/checkpoint sites
+    here would just warn and never fire).
+  * :class:`ChaosRunner` — executes one schedule against a cached engine
+    on known-oracle inputs and classifies the outcome
+    (``pass`` | ``classified`` | ``violation``).
+  * :func:`soak` — N seeded runs; returns outcomes plus a summary the
+    callers (bench.py ``--chaos``, tools_chaos.py, tests/test_chaos.py)
+    assert the invariant over.
+  * :func:`shrink` — greedy delta-debugging of a violating schedule down
+    to a minimal still-violating arm set; :func:`write_repro` persists the
+    ``(seed, arms)`` pair that replays it deterministically.
+
+Engine-heavy: import lazily (the robustness/__init__ discipline for
+degrade.py), e.g. ``from tpu_radix_join.robustness import chaos``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpu_radix_join.robustness import faults
+from tpu_radix_join.robustness.retry import DEVICE_UNAVAILABLE
+
+#: sites the ``join_arrays`` path consults, i.e. the arms that can fire in
+#: a soak run (faults.SITES minus the grid/checkpoint/stream/coordinator
+#: vocabulary, which only the out-of-core and multihost paths hit)
+CHAOS_SITES: Tuple[str, ...] = (
+    faults.SHUFFLE_OVERFLOW,
+    faults.DEVICE_INIT,
+    faults.EXCHANGE_CORRUPT,
+)
+
+#: failure class carried by an :class:`faults.InjectedFault` raised at a
+#: site (exceptions from *corrupting* sites instead surface through the
+#: engine's own classification)
+_SITE_CLASSES = {faults.DEVICE_INIT: DEVICE_UNAVAILABLE}
+
+PASS = "pass"
+CLASSIFIED = "classified"
+VIOLATION = "violation"
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """A replayable fault schedule: the injector seed plus the armed
+    ``(site, arm-kwargs)`` pairs.  Determinism is inherited from
+    :class:`faults.FaultInjector` (per-site ``random.Random(seed:site)``),
+    so ``(seed, arms)`` IS the repro."""
+
+    seed: int
+    arms: Tuple[Tuple[str, Tuple[Tuple[str, int], ...]], ...]
+
+    def arm_dicts(self) -> List[Tuple[str, Dict[str, int]]]:
+        return [(site, dict(kw)) for site, kw in self.arms]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"seed": self.seed,
+                "arms": [[site, dict(kw)] for site, kw in self.arms]}
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> "Schedule":
+        return cls(seed=int(obj["seed"]),
+                   arms=tuple((str(site),
+                               tuple(sorted((str(k), int(v))
+                                            for k, v in kw.items())))
+                              for site, kw in obj["arms"]))
+
+    def without(self, index: int) -> "Schedule":
+        return dataclasses.replace(
+            self, arms=self.arms[:index] + self.arms[index + 1:])
+
+
+@dataclasses.dataclass(frozen=True)
+class RunOutcome:
+    schedule: Schedule
+    status: str                       # PASS | CLASSIFIED | VIOLATION
+    failure_class: Optional[str]      # set when CLASSIFIED
+    matches: Optional[int]            # set when the join returned
+    detail: str = ""
+
+    def to_json(self) -> Dict[str, Any]:
+        return {"schedule": self.schedule.to_json(), "status": self.status,
+                "failure_class": self.failure_class,
+                "matches": self.matches, "detail": self.detail}
+
+
+def generate_schedule(seed: int) -> Schedule:
+    """1-3 distinct arms over :data:`CHAOS_SITES`, fully determined by
+    ``seed``.  The corruption and device-init sites are consulted once per
+    run, so their arm is always ``at=1``; the shuffle-overflow site is
+    consulted once per retry attempt, so its hit index varies — ``at=2``
+    exercises injection into an already-retried attempt."""
+    rng = random.Random(seed)
+    sites = rng.sample(CHAOS_SITES, rng.randint(1, len(CHAOS_SITES)))
+    arms = []
+    for site in sites:
+        at = rng.randint(1, 2) if site == faults.SHUFFLE_OVERFLOW else 1
+        arms.append((site, (("at", at),)))
+    return Schedule(seed=seed, arms=tuple(arms))
+
+
+class ChaosRunner:
+    """Executes fault schedules against one cached engine.
+
+    The engine, its mesh, and its compile cache are built once and reused
+    across the soak (per-run construction would recompile the pipeline
+    every time); the ``engine.device_init`` site — which in production
+    fires in the constructor — is therefore consulted explicitly at the
+    top of each run, modeling a fresh bring-up per schedule.
+
+    Inputs are oracle-friendly by construction: R's keys are a permutation
+    of 1..n (unique, covering) and S's are uniform over 1..n, so every
+    outer tuple matches exactly one inner tuple and the true count is
+    exactly ``n`` — any bit of injected corruption moves the count off the
+    oracle, making silent wrong answers detectable without a second join.
+    """
+
+    def __init__(self, num_nodes: int = 4, size: int = 1 << 12,
+                 verify: str = "check", data_seed: int = 0,
+                 config_overrides: Optional[Dict[str, Any]] = None):
+        from tpu_radix_join.core.config import JoinConfig
+        from tpu_radix_join.operators.hash_join import HashJoin
+        from tpu_radix_join.performance.measurements import Measurements
+        self._measurements_cls = Measurements
+        self.oracle = size
+        rng = np.random.default_rng(data_seed)
+        self._rk = (rng.permutation(size) + 1).astype(np.uint32)
+        self._sk = rng.integers(1, size + 1, size=size).astype(np.uint32)
+        self._rid = np.arange(size, dtype=np.uint32)
+        cfg = JoinConfig(num_nodes=num_nodes, verify=verify,
+                         **(config_overrides or {}))
+        self.config = cfg
+        self.engine = HashJoin(cfg)
+        self.measurements: List[Any] = []   # one registry per run, in order
+
+    def _batches(self):
+        import jax.numpy as jnp
+        from tpu_radix_join.data.tuples import TupleBatch
+        # fresh uncommitted arrays per run: the exchange-corruption site
+        # mutates its input host-side, and a shared committed batch would
+        # leak one run's damage into the next
+        return (TupleBatch(key=jnp.asarray(self._rk),
+                           rid=jnp.asarray(self._rid), key_hi=None),
+                TupleBatch(key=jnp.asarray(self._sk),
+                           rid=jnp.asarray(self._rid), key_hi=None))
+
+    def run(self, schedule: Schedule) -> RunOutcome:
+        m = self._measurements_cls()
+        self.measurements.append(m)
+        inj = faults.FaultInjector(seed=schedule.seed, measurements=m)
+        for site, kw in schedule.arm_dicts():
+            inj.arm(site, **kw)
+        try:
+            with inj:
+                # the constructor-time site, consulted per run because the
+                # engine is cached (see class docstring)
+                faults.check(faults.DEVICE_INIT, m)
+                result = self.engine.join_arrays(*self._batches())
+        except faults.InjectedFault as e:
+            cls = _SITE_CLASSES.get(e.site)
+            if cls is None:
+                return RunOutcome(schedule, VIOLATION, None, None,
+                                  f"unclassified injected fault: {e!r}")
+            return RunOutcome(schedule, CLASSIFIED, cls, None, repr(e))
+        except Exception as e:
+            cls = getattr(e, "failure_class", None)
+            if cls is None:
+                return RunOutcome(schedule, VIOLATION, None, None,
+                                  f"unclassified exception: {e!r}")
+            return RunOutcome(schedule, CLASSIFIED, cls, None, repr(e))
+        if result.ok:
+            if result.matches != self.oracle:
+                return RunOutcome(
+                    schedule, VIOLATION, None, result.matches,
+                    f"silent wrong count: {result.matches} != oracle "
+                    f"{self.oracle}")
+            return RunOutcome(schedule, PASS, None, result.matches)
+        cls = (result.diagnostics or {}).get("failure_class")
+        if not cls or cls == "ok":
+            return RunOutcome(schedule, VIOLATION, cls, result.matches,
+                              "ok=False without a failure class")
+        return RunOutcome(schedule, CLASSIFIED, cls, result.matches)
+
+
+def soak(runs: int, base_seed: int = 0, runner: Optional[ChaosRunner] = None,
+         verify: str = "check",
+         on_outcome: Optional[Callable[[RunOutcome], None]] = None):
+    """N seeded schedules (seeds ``base_seed .. base_seed+runs-1``) through
+    one runner.  Returns ``(outcomes, summary)``; asserting the no-violation
+    invariant is the caller's job (tests want to assert it, the violation
+    demo wants to harvest them)."""
+    runner = runner or ChaosRunner(verify=verify)
+    outcomes = []
+    for i in range(runs):
+        out = runner.run(generate_schedule(base_seed + i))
+        outcomes.append(out)
+        if on_outcome:
+            on_outcome(out)
+    summary = {
+        "runs": runs,
+        "base_seed": base_seed,
+        "verify": runner.config.verify,
+        "pass": sum(o.status == PASS for o in outcomes),
+        "classified": sum(o.status == CLASSIFIED for o in outcomes),
+        "violations": sum(o.status == VIOLATION for o in outcomes),
+        "failure_classes": sorted({o.failure_class for o in outcomes
+                                   if o.failure_class}),
+    }
+    return outcomes, summary
+
+
+def shrink(schedule: Schedule,
+           violates: Callable[[Schedule], bool]) -> Schedule:
+    """Greedy ddmin over arms: repeatedly drop any single arm whose removal
+    keeps the schedule violating, to a fixpoint.  Every candidate is
+    re-executed (the fault decisions are seed-deterministic, so a kept
+    reduction is guaranteed replayable), giving a 1-minimal repro: removing
+    any remaining arm makes the violation disappear."""
+    if not violates(schedule):
+        raise ValueError("shrink() needs a violating schedule to start from")
+    shrunk = True
+    while shrunk and len(schedule.arms) > 1:
+        shrunk = False
+        for i in range(len(schedule.arms)):
+            cand = schedule.without(i)
+            if violates(cand):
+                schedule = cand
+                shrunk = True
+                break
+    return schedule
+
+
+def write_repro(outcome: RunOutcome, path) -> str:
+    """Persist a violating run's minimal repro as one JSON object — the
+    ``(seed, arms)`` pair plus what went wrong — and return the JSON line
+    (printed by the soak CLIs so the repro survives even if the artifact
+    dir does not)."""
+    line = json.dumps(outcome.to_json(), sort_keys=True)
+    with open(path, "w") as f:
+        f.write(line + "\n")
+    return line

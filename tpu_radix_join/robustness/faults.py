@@ -15,8 +15,10 @@ Usage::
 
 Injectors nest via a stack; only the innermost (top) injector is consulted,
 so a test's injector shadows any ambient one.  Sites are plain strings —
-``arm`` accepts unknown names (forward compatibility for downstream
-experiments) but the canonical vocabulary is :data:`SITES`.
+``arm`` still accepts unknown names (forward compatibility for downstream
+experiments) but warns with a difflib near-miss suggestion, so a typo'd
+chaos schedule doesn't silently no-op; the canonical vocabulary is
+:data:`SITES`.
 
 Determinism contract: per-site decisions come from
 ``random.Random(f"{seed}:{site}")``, so the same seed + same hit sequence
@@ -25,7 +27,9 @@ replays the same failures (tested in tests/test_robustness.py).
 
 from __future__ import annotations
 
+import difflib
 import random
+import warnings
 from typing import Dict, List, Optional, Tuple
 
 from tpu_radix_join.performance.measurements import FINJECT
@@ -37,11 +41,13 @@ COORD_CONNECT = "multihost.coordinator_connect"  # distributed-init timeout
 GRID_KILL = "grid.mid_chunk_kill"              # hard kill between slabs
 GRID_TRANSIENT = "grid.transient"              # retryable per-pair hiccup
 STREAM_CORRUPT = "stream.corrupt_lane"         # sentinel-damaged key lane
+EXCHANGE_CORRUPT = "exchange.corrupt_lane"     # bit-flipped key post-exchange
 CKPT_SAVE = "checkpoint.save"                  # checkpoint write I/O error
 CKPT_LOAD = "checkpoint.load"                  # checkpoint read I/O error
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
-         GRID_TRANSIENT, STREAM_CORRUPT, CKPT_SAVE, CKPT_LOAD)
+         GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
+         CKPT_LOAD)
 
 
 class InjectedFault(RuntimeError):
@@ -118,6 +124,13 @@ class FaultInjector:
         """
         if at is None and p is None and times is None:
             times = None   # fire every hit
+        if site not in SITES:
+            near = difflib.get_close_matches(site, SITES, n=1, cutoff=0.6)
+            hint = f"; did you mean {near[0]!r}?" if near else ""
+            warnings.warn(
+                f"arming unknown fault site {site!r} — no engine code "
+                f"consults it, so this arm will never fire{hint}",
+                RuntimeWarning, stacklevel=2)
         self._arms[site] = _Arm(site, self.seed, at, p, times, exc)
         return self
 
@@ -152,6 +165,13 @@ class FaultInjector:
     def fired(self, site: str) -> int:
         arm = self._arms.get(site)
         return arm.fired if arm else 0
+
+    def site_stats(self) -> Dict[str, Dict[str, int]]:
+        """Per-armed-site ``{"hits": n, "fired": n}`` — the accounting that
+        lands in ``JoinResult.diagnostics["fault_sites"]`` and the
+        ``print_results`` FaultSites aggregate."""
+        return {site: {"hits": arm.hits, "fired": arm.fired}
+                for site, arm in self._arms.items()}
 
     # ------------------------------------------------------------- stacking
     def __enter__(self) -> "FaultInjector":

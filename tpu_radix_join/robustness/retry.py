@@ -21,6 +21,11 @@ and surfaced by main.py / bench reports):
     FATAL: indicates a bug, not a sizing problem.
   * ``count_overflow_risk``  — match count near the uint32 accumulator
     edge.  FATAL for the current dtype config.
+  * ``data_corruption``      — a per-partition integrity checksum
+    (verify.py: count / sum / xor-fold of key lanes) disagreed across
+    pipeline stages, or the join-level cross-check failed.  FATAL for
+    the attempt — but partition-granular (``--verify repair``
+    recomputes only the damaged partitions, hash_join.py).
   * ``device_unavailable``   — accelerator/mesh init failed (degrade.py).
   * ``coordinator_timeout``  — distributed init could not reach the
     coordinator within policy (multihost.initialize).
@@ -51,6 +56,7 @@ CAPACITY_OVERFLOW = "capacity_overflow"
 KEY_CONTRACT = "key_contract"
 CONSERVATION = "conservation"
 COUNT_OVERFLOW_RISK = "count_overflow_risk"
+DATA_CORRUPTION = "data_corruption"
 DEVICE_UNAVAILABLE = "device_unavailable"
 COORDINATOR_TIMEOUT = "coordinator_timeout"
 INTERRUPTED = "interrupted"
@@ -64,6 +70,7 @@ BACKEND_UNAVAILABLE = "backend_unavailable"
 _FATAL_FLAGS = (
     ("key_contract_violations", KEY_CONTRACT),
     ("conservation_violations", CONSERVATION),
+    ("data_corruption_partitions", DATA_CORRUPTION),
     ("count_overflow_risk", COUNT_OVERFLOW_RISK),
 )
 _CAPACITY_FLAGS = ("shuffle_overflow_r_tuples", "shuffle_overflow_s_tuples",
