@@ -220,6 +220,81 @@ def _run_chaos(runs, base_seed=0):
     return 0 if summary["violations"] == 0 else 1
 
 
+def _run_grid_bench(check_baseline=None):
+    """``--grid-bench``: A/B of the out-of-core grid engines (ops/chunked.py
+    ``--grid-pipeline off`` vs ``on``) on a 4x4 chunk grid, CPU-sized like
+    ``--chaos`` — it validates the pipeline's overlap win and work counters
+    (GRIDPAIRS/PREFETCH/SORTREUSE), not chip throughput.  Prints one BENCH
+    JSON line whose headline ``value`` is pipelined pairs/sec and whose
+    ``vs_baseline``/``speedup`` is pipelined-over-synchronous, so
+    tools_check_regress.py fails loudly when the pipeline regresses."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.data.streaming import stream_chunks_device
+    from tpu_radix_join.ops.chunked import chunked_join_grid
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import (GRIDPAIRS, PREFETCH,
+                                                         SORTREUSE)
+
+    chunk = 1 << 15                  # 32K-tuple chunks -> 4x4 grid
+    size = chunk * 4
+    inner = Relation(size, 1, "unique", seed=11)
+    outer = Relation(size, 1, "unique", seed=12)
+    expected = inner.expected_matches(outer)
+
+    def run(mode, meas=None):
+        # inner streamed once, outer regenerated per row (the out-of-core
+        # shape): generation overlap is part of what the pipeline hides
+        t0 = time.perf_counter()
+        total = chunked_join_grid(
+            stream_chunks_device(inner, 0, chunk),
+            lambda: stream_chunks_device(outer, 0, chunk),
+            chunk, measurements=meas, pipeline=mode)
+        return total, time.perf_counter() - t0
+
+    stats = {}
+    for mode in ("off", "on"):
+        run(mode)                    # warmup: compiles + thread spinup
+        meas = Measurements(node_id=0, num_nodes=1)
+        total, wall = run(mode, meas)
+        if expected is not None and total != expected:
+            print(f"ERROR: grid total {total} != oracle {expected} "
+                  f"(pipeline={mode})", file=sys.stderr)
+            sys.exit(3)
+        pairs = meas.counters.get(GRIDPAIRS, 0)
+        stats[mode] = {"wall_s": wall, "pairs": pairs,
+                       "pairs_per_sec": pairs / wall if wall > 0 else 0.0,
+                       "prefetch": meas.counters.get(PREFETCH, 0),
+                       "sortreuse": meas.counters.get(SORTREUSE, 0)}
+        print(f"note: pipeline={mode}: {wall*1e3:.1f} ms, "
+              f"{stats[mode]['pairs_per_sec']:.2f} pairs/s, "
+              f"PREFETCH={stats[mode]['prefetch']} "
+              f"SORTREUSE={stats[mode]['sortreuse']}", file=sys.stderr)
+    speedup = (stats["on"]["pairs_per_sec"]
+               / max(stats["off"]["pairs_per_sec"], 1e-9))
+    result = {
+        "metric": "grid_join_pipeline",
+        "value": round(stats["on"]["pairs_per_sec"], 3),
+        "unit": "pairs/sec",
+        "vs_baseline": round(speedup, 4),
+        "speedup": round(speedup, 4),
+        "pairs_per_sec_sync": round(stats["off"]["pairs_per_sec"], 3),
+        "pairs_per_sec_pipelined": round(stats["on"]["pairs_per_sec"], 3),
+        "gridpairs": stats["on"]["pairs"],
+        "prefetch": stats["on"]["prefetch"],
+        "sortreuse": stats["on"]["sortreuse"],
+    }
+    print(json.dumps(result))
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
@@ -252,6 +327,10 @@ def main():
             print(f"error: baseline {check_baseline} not found",
                   file=sys.stderr)
             sys.exit(2)
+    if "--grid-bench" in argv:
+        # like --chaos: CPU-sized, exits before the chip-reservation
+        # machinery — it gates the pipelined grid engine, not the chip
+        sys.exit(_run_grid_bench(check_baseline))
 
     size = 1 << 24               # 16M tuples per side
     planned = _planned_strategy(size, iters=20)

@@ -1,0 +1,309 @@
+"""Pipelined out-of-core grid engine (ops/chunked.py pipeline="on"):
+oracle parity with the synchronous loop, observable sort reuse and
+prefetch overlap, write-behind checkpoint invariants under kill, and the
+hoisted key-range bound contract checks."""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_radix_join.data.relation import Relation
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.chunked import chunked_join_count, chunked_join_grid
+from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY
+from tpu_radix_join.performance.measurements import (CKPTLOAD, GRIDPAIRS,
+                                                     PREFETCH, SORTREUSE,
+                                                     Measurements)
+from tpu_radix_join.robustness import faults
+from tpu_radix_join.robustness.checkpoint import CheckpointMismatch
+from tpu_radix_join.robustness.faults import (FaultInjector, InjectedKill,
+                                              TransientFault)
+from tpu_radix_join.robustness.retry import RetryPolicy
+
+
+def _quarters(seed, n=1 << 12):
+    rel = Relation(n, 1, "unique", seed=seed)
+    b = rel.shard(0)
+    k, r = np.asarray(b.key), np.asarray(b.rid)
+    q = n // 4
+    return [TupleBatch(key=jnp.asarray(k[i * q:(i + 1) * q]),
+                       rid=jnp.asarray(r[i * q:(i + 1) * q]))
+            for i in range(4)]
+
+
+def _random_chunks(seed, n_chunks, size=1 << 10, hi=1 << 16):
+    rng = np.random.default_rng(seed)
+    return [TupleBatch(key=jnp.asarray(
+                           rng.integers(0, hi, size, dtype=np.uint32)),
+                       rid=jnp.arange(size, dtype=jnp.uint32))
+            for _ in range(n_chunks)]
+
+
+def _oracle(r_chunks, s_chunks):
+    from collections import Counter
+    cnt = Counter(np.concatenate(
+        [np.asarray(c.key) for c in r_chunks]).tolist())
+    return sum(cnt[k] for k in np.concatenate(
+        [np.asarray(c.key) for c in s_chunks]).tolist())
+
+
+# ------------------------------------------------------------ oracle parity
+
+def test_pipelined_matches_sync_with_duplicates():
+    """Both engines return the oracle total on a duplicate-heavy 3x4 grid;
+    the pipelined run shows its work: SORTREUSE == rows x (cols - 1) and
+    every chunk staged through the prefetch thread."""
+    r_chunks = _random_chunks(1, 3)
+    s_chunks = _random_chunks(2, 4)
+    oracle = _oracle(r_chunks, s_chunks)
+
+    m_off = Measurements()
+    assert chunked_join_grid(r_chunks, s_chunks, 1 << 9,
+                             measurements=m_off, pipeline="off") == oracle
+    assert SORTREUSE not in m_off.counters
+    assert PREFETCH not in m_off.counters
+
+    m_on = Measurements()
+    assert chunked_join_grid(r_chunks, s_chunks, 1 << 9,
+                             measurements=m_on, pipeline="on") == oracle
+    assert m_on.counters[GRIDPAIRS] == 12
+    assert m_on.counters[SORTREUSE] == 3 * (4 - 1)
+    # 3 inner chunks + 4 outer chunks re-staged for each of the 3 rows
+    assert m_on.counters[PREFETCH] == 3 + 3 * 4
+
+
+def test_pipeline_auto_resolution():
+    """auto pipelines any grid larger than 1x1 and falls back to the
+    synchronous loop for a single pair (nothing to overlap)."""
+    chunks = _quarters(7)
+    m = Measurements()
+    assert chunked_join_grid(chunks, chunks, 1 << 10, measurements=m,
+                             pipeline="auto") == 1 << 12
+    assert m.counters[SORTREUSE] == 4 * 3
+
+    one = [chunks[0]]
+    m1 = Measurements()
+    total = chunked_join_grid(one, one, 1 << 10, measurements=m1,
+                              pipeline="auto")
+    assert total == 1 << 10
+    assert PREFETCH not in m1.counters      # resolved to the sync loop
+
+    with pytest.raises(ValueError, match="pipeline mode"):
+        chunked_join_grid(one, one, 1 << 10, pipeline="sideways")
+
+
+def test_pipelined_wide_keys():
+    """Wide (hi/lo) chunks ride the pipeline too — per-pair union sort
+    (no presorted probe, SORTREUSE stays 0) but prefetch still stages."""
+    n = 1 << 10
+    rng = np.random.default_rng(5)
+    lo = rng.integers(0, 1 << 16, n, dtype=np.uint32)
+
+    def mk():
+        return TupleBatch(key=jnp.asarray(lo),
+                          rid=jnp.arange(n, dtype=jnp.uint32),
+                          key_hi=jnp.asarray(np.zeros(n, np.uint32)))
+
+    chunks = [mk(), mk()]
+    oracle = _oracle(chunks, chunks)
+    m = Measurements()
+    assert chunked_join_grid(chunks, chunks, 256, measurements=m,
+                             pipeline="on") == oracle
+    assert m.counters[GRIDPAIRS] == 4
+    assert SORTREUSE not in m.counters
+    assert m.counters[PREFETCH] > 0
+
+
+# --------------------------------------------------------------- real overlap
+
+def test_prefetch_overlaps_compute():
+    """Deterministic overlap: the prefetch span that stages outer chunk
+    j+1 begins BEFORE the grid_pair span of pair (i, j) ends — the
+    prefetch thread is already generating the next chunk while the pair
+    computes, which is the entire point of the stage."""
+    r_chunks = _random_chunks(11, 2)
+    s_data = _random_chunks(12, 3)
+
+    def s_factory():
+        return iter(s_data)          # generator-fed outer side
+
+    m = Measurements()
+    tr = m.attach_tracer(nodes=1)
+    total = chunked_join_grid(r_chunks, s_factory, 1 << 9,
+                              measurements=m, pipeline="on")
+    assert total == _oracle(r_chunks, s_data)
+
+    gp = [e for e in tr.events if e["name"] == "grid_pair"
+          and e["args"].get("i") == 0 and e["args"].get("j") == 0]
+    pf = [e for e in tr.events if e["name"] == "prefetch"
+          and e["args"].get("side") == "outer"
+          and e["args"].get("chunk") == 1]
+    assert gp and pf
+    gp_end = gp[0]["ts"] + gp[0]["dur"]
+    # earliest chunk-1 staging (row 0's) starts inside pair (0,0)'s span
+    assert min(e["ts"] for e in pf) < gp_end
+    # readback and checkpoint flushes are on the timeline too
+    names = {e["name"] for e in tr.events}
+    assert "readback_flush" in names
+
+
+# ------------------------------------------- write-behind checkpoint + kill
+
+def test_kill_during_write_behind_no_overclaim_and_zero_recompute(tmp_path):
+    """Kill the pipelined grid mid-flight: the write-behind checkpoint may
+    trail the dispatch front, but every CLAIMED pair is realized (the
+    stored total is exactly the claimed prefix's oracle) and never exceeds
+    the dispatched count; the resume probes exactly the unclaimed pairs
+    and lands on the oracle — in either engine mode."""
+    r_chunks, s_chunks = _quarters(1), _quarters(1)   # diag pairs match 1024
+    ckpt = str(tmp_path / "grid.ckpt")
+
+    m1 = Measurements()
+    with FaultInjector() as inj:
+        inj.arm(faults.GRID_KILL, at=5, exc=InjectedKill)
+        with pytest.raises(InjectedKill):
+            chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              measurements=m1, pipeline="on")
+    dispatched = m1.counters[GRIDPAIRS]
+    assert dispatched == 4               # kill fired before the 5th dispatch
+    state = json.load(open(ckpt))
+    assert not state["done"]
+    claimed = state["i"] * state["cols"] + state["j"]
+    # no over-claim: the cursor never passes the readback front, and the
+    # flushed total is exactly the claimed row-major prefix's matches
+    assert claimed <= dispatched
+    assert claimed == 2                  # readback_depth=2 pairs in flight
+    diag_in_prefix = sum(1 for p in range(claimed)
+                         if p // 4 == p % 4)
+    assert state["total"] == diag_in_prefix * (1 << 10)
+
+    killed_bytes = open(ckpt, "rb").read()
+    for mode in ("on", "off"):           # checkpoints are engine-portable
+        with open(ckpt, "wb") as f:      # restore the killed state each leg
+            f.write(killed_bytes)
+        m2 = Measurements()
+        total = chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                                  checkpoint_path=ckpt, checkpoint_tag="t",
+                                  measurements=m2, pipeline=mode)
+        assert total == 1 << 12
+        assert m2.counters[CKPTLOAD] >= 1
+        assert m2.counters[GRIDPAIRS] == 16 - claimed   # zero recompute
+        assert json.load(open(ckpt))["done"]
+
+
+def test_pipelined_transient_retry():
+    r_chunks, s_chunks = _quarters(2), _quarters(2)
+    m = Measurements()
+    with FaultInjector() as inj:
+        inj.arm(faults.GRID_TRANSIENT, times=1, exc=TransientFault)
+        total = chunked_join_grid(
+            r_chunks, s_chunks, 1 << 10, measurements=m, pipeline="on",
+            retry_policy=RetryPolicy(max_attempts=3, base_delay_s=0.0))
+    assert total == 1 << 12
+    assert inj.fired(faults.GRID_TRANSIENT) == 1
+    assert m.counters[GRIDPAIRS] == 16
+
+
+# ------------------------------------------------- extent hardening + logs
+
+def test_generator_grid_shape_mismatch_fails_fast(tmp_path):
+    """A generator-fed grid has rows/cols None in the checkpoint
+    fingerprint; the discovered extents recorded in the saved state must
+    fail a same-tag resume whose grid discovers a different shape instead
+    of mis-resuming row-major arithmetic."""
+    r_chunks = _quarters(4)
+    s4 = _quarters(4)
+    s5 = _random_chunks(13, 5, size=1 << 10)   # one extra outer chunk
+    ckpt = str(tmp_path / "grid.ckpt")
+
+    with FaultInjector() as inj:
+        # row 0 completes (cols=4 discovered and saved) before the kill
+        inj.arm(faults.GRID_KILL, at=6, exc=InjectedKill)
+        with pytest.raises(InjectedKill):
+            chunked_join_grid(r_chunks, lambda: iter(s4), 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              pipeline="off")
+    assert json.load(open(ckpt))["cols"] == 4
+
+    with pytest.raises(CheckpointMismatch, match="grid shape"):
+        chunked_join_grid(r_chunks, lambda: iter(s5), 1 << 10,
+                          checkpoint_path=ckpt, checkpoint_tag="t",
+                          pipeline="off")
+
+
+def test_resume_log_and_rate_progress(tmp_path, capsys):
+    r_chunks, s_chunks = _quarters(6), _quarters(6)
+    ckpt = str(tmp_path / "grid.ckpt")
+    with FaultInjector() as inj:
+        inj.arm(faults.GRID_KILL, at=4, exc=InjectedKill)
+        with pytest.raises(InjectedKill):
+            chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              progress=True, pipeline="off")
+    out = capsys.readouterr().out
+    assert "pairs/s" in out and "eta=" in out
+
+    total = chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                              checkpoint_path=ckpt, checkpoint_tag="t",
+                              progress=True, pipeline="on")
+    assert total == 1 << 12
+    out = capsys.readouterr().out
+    assert "[grid] resume: skipping 3 completed pair(s)" in out
+
+
+# --------------------------------------------------- key-bound hoist contract
+
+def test_chunked_join_count_key_bound_contracts():
+    n = 256
+    keys = np.arange(n, dtype=np.uint32)
+    mk = lambda k: TupleBatch(key=jnp.asarray(k),
+                              rid=jnp.arange(n, dtype=jnp.uint32))
+    a = chunked_join_count(mk(keys), mk(keys), 64)
+    assert a == chunked_join_count(mk(keys), mk(keys), 64,
+                                   key_bound=int(keys.max()))
+    # the bound replaces the probe, not the checks: sentinel-range bounds
+    # still classify as corruption, narrow bounds above the packing raise
+    with pytest.raises(ValueError, match="sentinel"):
+        chunked_join_count(mk(keys), mk(keys), 64, key_bound=0xFFFFFFFE)
+    with pytest.raises(ValueError, match="key contract violation"):
+        chunked_join_count(mk(keys), mk(keys), 64, key_range="narrow",
+                           key_bound=MAX_MERGE_KEY + 1)
+    # a full-range bound routes to the lexicographic count transparently
+    big = keys.copy()
+    big[0] = MAX_MERGE_KEY + 5
+    got = chunked_join_count(mk(big), mk(big), 64,
+                             key_bound=int(big.max()))
+    assert got == n
+
+
+def test_pipelined_sentinel_corruption_detected():
+    """The presorted probe compares raw keys, so an inner key in the
+    sentinel range would silently pad-match the outer fill — the pipeline
+    must classify it as corruption instead (DataCorruption <: ValueError),
+    in every key_range mode."""
+    n = 512
+    bad = np.arange(n, dtype=np.uint32)
+    bad[3] = 0xFFFFFFFF
+    mk = lambda k: TupleBatch(key=jnp.asarray(k),
+                              rid=jnp.arange(n, dtype=jnp.uint32))
+    chunks_bad = [mk(bad), mk(bad)]
+    chunks_ok = [mk(np.arange(n, dtype=np.uint32))] * 2
+    with pytest.raises(ValueError, match="sentinel"):
+        chunked_join_grid(chunks_bad, chunks_ok, 128, pipeline="on")
+
+
+# ----------------------------------------------------------- regress wiring
+
+def test_grid_bench_tags_gate_in_the_right_direction():
+    """--grid-bench JSON tags must regress downward-is-bad: a pipeline
+    that stages fewer chunks or reuses fewer sorts silently went serial."""
+    from tpu_radix_join.observability.regress import higher_is_better
+    for tag in ("pairs_per_sec_pipelined", "pairs_per_sec_sync", "speedup",
+                "prefetch", "sortreuse", "vs_baseline", "value"):
+        assert higher_is_better(tag), tag
+    for tag in ("wall_s_sync", "predicted_ms"):
+        assert not higher_is_better(tag), tag

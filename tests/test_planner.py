@@ -98,7 +98,10 @@ def test_crossover_memory_budget_routes_to_chunked():
     w_oom = dataclasses.replace(w_fits, memory_budget_bytes=1 << 20)
     plan, costs = plan_join(PROF, w_oom)
     assert plan.engine == "chunked"
-    assert plan.strategy == "chunked_grid"
+    # the pipelined grid row (sort-reuse + overlap) undercuts the
+    # synchronous grid, so OOM workloads route to it with pipeline on
+    assert plan.strategy == "chunked_grid_pipelined"
+    assert plan.grid_pipeline == "on"
     assert plan.chunk_tuples and plan.chunk_tuples & (plan.chunk_tuples - 1) == 0
     assert not _strategy(costs, "incore_fused_sort_narrow").feasible
 

@@ -96,6 +96,15 @@ class JoinConfig:
     #               whose only capacity is the caller-chosen slab size; the
     #               result carries diagnostics["degraded"] = "chunked".
     fallback: str = "none"
+    # Out-of-core grid engine (ops/chunked.chunked_join_grid) used by the
+    # chunked fallback and verify="repair":
+    #   "off"  — synchronous loop (one probe, one readback, one checkpoint
+    #            fsync per pair, in program order).
+    #   "on"   — pipelined engine: once-per-row inner sorts probed by
+    #            binary search, double-buffered chunk prefetch, deferred
+    #            readbacks, write-behind checkpoints.
+    #   "auto" — pipelined for any grid larger than a single chunk pair.
+    grid_pipeline: str = "auto"
     # Pause between capacity-grow retry attempts (0 = immediate, the
     # pre-robustness behavior).  Exponential with deterministic jitter
     # (robustness/retry.RetryPolicy): attempt k sleeps
@@ -172,6 +181,9 @@ class JoinConfig:
             raise ValueError("max_retries must be >= 0")
         if self.fallback not in ("none", "chunked"):
             raise ValueError(f"unknown fallback mode {self.fallback!r}")
+        if self.grid_pipeline not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown grid pipeline mode {self.grid_pipeline!r}")
         if self.retry_backoff_s < 0 or self.retry_backoff_max_s < 0:
             raise ValueError("retry backoff delays must be >= 0")
         if self.retry_backoff_mult < 1.0:

@@ -71,6 +71,15 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run the out-of-core grid join (ops/chunked.py) "
                         "streaming both relations in chunks of this many "
                         "tuples; single-node only")
+    p.add_argument("--grid-pipeline", choices=["off", "on", "auto"],
+                   default="auto",
+                   help="out-of-core grid engine: 'on' overlaps chunk "
+                        "prefetch, probe compute, host readbacks, and "
+                        "checkpoint flushes (inner chunks sorted once per "
+                        "grid row); 'off' keeps the synchronous "
+                        "one-pair-at-a-time loop (the A/B lever); 'auto' "
+                        "pipelines any grid larger than one chunk pair "
+                        "(planner plans may override auto)")
     p.add_argument("--checkpoint-dir", default=None,
                    help="grid mode: directory for the slab-boundary "
                         "checkpoint file (atomic save after every chunk "
@@ -187,6 +196,11 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
                           base_delay_s=args.retry_backoff or 0.5,
                           jitter=0.1)
               if args.max_retries else None)
+    # --grid-pipeline "auto" defers to a planner plan's decision (the cost
+    # model priced both grid rows); an explicit off/on flag wins the A/B
+    pipeline = args.grid_pipeline
+    if pipeline == "auto" and plan is not None and plan.engine == "chunked":
+        pipeline = plan.grid_pipeline
     meas.set_trace_tags(strategy="chunked_grid", engine="chunked")
     meas.start("JTOTAL")
     try:
@@ -196,7 +210,7 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
             min(chunk, 1 << 20),
             checkpoint_path=ckpt_path, checkpoint_tag=tag,
             progress=True, key_range=args.key_range, measurements=meas,
-            retry_policy=policy, plan=plan)
+            retry_policy=policy, plan=plan, pipeline=pipeline)
     except Exception as e:
         # a classified failure (e.g. DataCorruption from a key lane in the
         # sentinel range — the streamed-lane corruption signature) exits

@@ -26,7 +26,12 @@ DEFAULT_THRESHOLD = 0.25       # bench timings through a shared tunnel are
 # tags where larger is better (everything else is treated as a cost)
 _HIGHER_BETTER = {"value", "vs_baseline"}
 _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
-                             "tuples_per_sec")
+                             "tuples_per_sec", "per_sec", "pairs/sec",
+                             "speedup",
+                             # pipelined-grid work counters (--grid-bench):
+                             # fewer staged chunks / reused sorts = the
+                             # pipeline silently fell back to serial work
+                             "prefetch", "sortreuse")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
 

@@ -1773,7 +1773,8 @@ class HashJoin:
                             rid=jnp.zeros(int(ssel.sum()), jnp.uint32),
                             key_hi=None if shi is None
                             else jnp.asarray(shi[ssel]))],
-                        min(slab, int(ssel.sum())), measurements=m)
+                        min(slab, int(ssel.sum())), measurements=m,
+                        pipeline=cfg.grid_pipeline)
                 # the recomputed count has no per-device decomposition;
                 # park it in row 0 of its column (the uint64 total above
                 # is exact — partition_counts stays a uint32 view)

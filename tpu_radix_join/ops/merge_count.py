@@ -89,6 +89,51 @@ def _weights(packed_sorted: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return _run_weights(is_s, key != prev_key), key
 
 
+@jax.jit
+def presort_keys(keys: jnp.ndarray) -> jnp.ndarray:
+    """Sort a raw key lane once for reuse across many probes.
+
+    The sorted array is the "inner side" input of
+    :func:`merge_count_presorted`: the out-of-core grid sorts each inner
+    chunk once per grid *row* and probes every outer chunk of the row
+    against it, eliminating the ``(n_outer_chunks - 1)`` redundant sorts
+    the packed-union discipline pays per row (ops/chunked.py pipeline).
+    No packing, no side tag: the raw uint32 keys sort as-is, so the full
+    sub-sentinel key range is supported without the 31-bit
+    :data:`MAX_MERGE_KEY` ceiling."""
+    return _sort_unstable(keys)
+
+
+def merge_count_presorted(r_sorted: jnp.ndarray, s_keys: jnp.ndarray,
+                          return_max_weight: bool = False):
+    """Duplicate-aware match count of ``s_keys`` against an ALREADY-SORTED
+    inner key lane (:func:`presort_keys` output): two binary searches per
+    outer key — ``upper_bound - lower_bound`` over the sorted inner is
+    exactly the per-outer-tuple match weight — instead of re-sorting the
+    packed union per probe.  O(m log n) gathers against the resident
+    sorted inner; on the sort-bound grid engine this converts the per-pair
+    sort into a once-per-row sort.
+
+    Key-range discipline: none needed — raw uint32 comparisons cover every
+    sub-sentinel key, so there is no narrow/full split on this path.  The
+    caller must keep real keys out of the reserved sentinel range
+    (``<= 0xFFFFFFFD``, tuples.py): an outer S pad (0xFFFFFFFF) can then
+    never equal an inner key and contributes zero weight, and an inner
+    sentinel would silently pad-match — the grid's per-chunk key-bound
+    check (ops/chunked.py) enforces this loudly.
+
+    Returns the uint32 total (overflow-safe iff ``max_weight * len(s_keys)
+    < 2**32``, the same window guard as ``merge_count_chunks``);
+    ``return_max_weight`` also returns the max per-outer-tuple weight."""
+    lb = jnp.searchsorted(r_sorted, s_keys, side="left").astype(jnp.uint32)
+    ub = jnp.searchsorted(r_sorted, s_keys, side="right").astype(jnp.uint32)
+    weight = ub - lb
+    total = jnp.sum(weight, dtype=jnp.uint32)
+    if return_max_weight:
+        return total, jnp.max(weight)
+    return total
+
+
 def merge_count_chunks(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
                        num_chunks: int = 4096,
                        return_max_weight: bool = False):

@@ -67,6 +67,12 @@ CKPTSAVE = "CKPTSAVE"      # checkpoints written (robustness/checkpoint.py)
 CKPTLOAD = "CKPTLOAD"      # checkpoints resumed from
 GRIDPAIRS = "GRIDPAIRS"    # chunk pairs actually probed by chunked_join_grid
                            # (resume skips completed pairs — see ops/chunked.py)
+PREFETCH = "PREFETCH"      # chunks staged by the grid prefetch thread before
+                           # the consuming pair asked for them (ops/chunked.py
+                           # pipelined mode; each carries a "prefetch" span)
+SORTREUSE = "SORTREUSE"    # grid pair probes that reused the row's presorted
+                           # inner chunk instead of re-sorting the packed
+                           # union — rows x (cols - 1) on a full grid
 VCHK = "VCHK"              # integrity-verification timing tag (times_us ONLY:
                            # summary() merges counters over times on a shared
                            # key, so the check count lives under VCHKN)

@@ -20,7 +20,9 @@ from tpu_radix_join.planner.cost_model import (StrategyCost, Workload,
                                                pick_chunk_tuples)
 from tpu_radix_join.planner.profile import DeviceProfile
 
-PLAN_SCHEMA_VERSION = 1
+# v2 adds ``grid_pipeline`` (the chunked engine's pipelined/synchronous
+# knob); v1 files load with its default ("auto").
+PLAN_SCHEMA_VERSION = 2
 
 
 class PlanError(ValueError):
@@ -45,6 +47,7 @@ class JoinPlan:
     network_fanout_bits: int = 5
     local_fanout_bits: int = 5
     chunk_tuples: Optional[int] = None   # chunked engine only
+    grid_pipeline: str = "auto"          # chunked engine: "off"|"on"|"auto"
     pipeline_repeats: bool = False
     strategy: str = ""
     predicted_ms: float = 0.0
@@ -127,9 +130,11 @@ def plan_join(profile: DeviceProfile, workload: Workload
               pipeline_repeats=workload.repeats > 1,
               strategy=best.strategy, predicted_ms=best.cost_ms,
               profile_name=profile.name)
-    if best.strategy == "chunked_grid":
+    if best.strategy in ("chunked_grid", "chunked_grid_pipelined"):
         plan = JoinPlan(engine="chunked",
                         chunk_tuples=pick_chunk_tuples(profile, workload),
+                        grid_pipeline=("on" if best.strategy.endswith(
+                            "_pipelined") else "off"),
                         key_range="auto" if workload.key_bound is None
                         else ("full" if not _narrow(workload) else "narrow"),
                         pipeline_repeats=False,

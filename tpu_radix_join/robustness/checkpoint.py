@@ -90,14 +90,21 @@ class CheckpointManager:
                     done=bool(state.get("done")))
         return state
 
-    def save(self, state: dict, done: bool = False) -> bool:
+    def save(self, state: dict, done: bool = False,
+             span: str = "ckpt_save") -> bool:
         """Atomically persist ``state`` (+ ``done`` + fingerprint); returns
         False (after recording a trace event) on I/O failure instead of
-        raising — losing one resume point must not kill the join."""
+        raising — losing one resume point must not kill the join.
+
+        ``span`` names the timeline span the write is recorded under:
+        "ckpt_save" for synchronous critical-path saves, "ckpt_flush" when
+        the write happens on the :class:`AsyncCheckpointWriter`'s flush
+        thread (off the critical path — the distinction is what the
+        overlap timeline shows)."""
         m = self.measurements
         tmp = f"{self.path}.tmp.{os.getpid()}"
         try:
-            with self._span("ckpt_save"):
+            with self._span(span):
                 _faults.check(_faults.CKPT_SAVE, m)
                 with open(tmp, "w") as f:
                     json.dump({**state, "done": done,
@@ -117,3 +124,78 @@ class CheckpointManager:
         if m is not None:
             m.incr(CKPTSAVE)
         return True
+
+
+class AsyncCheckpointWriter:
+    """Write-behind mode for a :class:`CheckpointManager`: ``save()``
+    enqueues and returns immediately; a single daemon thread performs the
+    fsync + rename while the caller computes the next chunk pair
+    (ops/chunked.py pipelined grid).
+
+    Semantics that preserve the "every saved pair is realized" resume
+    invariant:
+
+      * **Latest-wins coalescing** — the queue holds at most ONE pending
+        state; enqueueing replaces it.  A newer state always covers a
+        strict superset of realized pairs, so dropping the older write
+        loses at most one resume point, never correctness (the same
+        trade the manager's swallowed-save rule already makes).
+      * **Callers enqueue only realized states** — the grid resolves a
+        pair's device counts to a host total *before* enqueueing, so no
+        state on disk ever claims an unrealized pair.
+      * **flush() is a barrier** — returns only once every enqueued state
+        has hit the disk (or failed into the manager's
+        ``checkpoint_save_failed`` event); the grid flushes before its
+        final synchronous ``done=True`` save and on every exit path.
+
+    Writes are recorded under the "ckpt_flush" span (the timeline shows
+    them overlapping the next pair's "grid_pair" span instead of
+    serializing after it).
+    """
+
+    def __init__(self, manager: CheckpointManager):
+        import threading
+        self._mgr = manager
+        self._cond = threading.Condition()
+        self._pending = None          # (state, done) | None
+        self._busy = False
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._run, name="ckpt-write-behind", daemon=True)
+        self._thread.start()
+
+    def save(self, state: dict, done: bool = False) -> None:
+        with self._cond:
+            self._pending = (dict(state), done)
+            self._cond.notify_all()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait()
+                if self._pending is None:
+                    return            # stopped with nothing left to write
+                state, done = self._pending
+                self._pending = None
+                self._busy = True
+            try:
+                self._mgr.save(state, done=done, span="ckpt_flush")
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+
+    def flush(self) -> None:
+        """Barrier: every state enqueued before this call is on disk (or
+        recorded as a failed save) when it returns."""
+        with self._cond:
+            while self._pending is not None or self._busy:
+                self._cond.wait()
+
+    def close(self) -> None:
+        """Flush outstanding writes and stop the thread (idempotent)."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join()
