@@ -26,6 +26,7 @@ stderr, and the process exits 1 on any regression — the JSON line above
 is printed either way.
 """
 
+import contextlib
 import glob
 import json
 import math
@@ -295,6 +296,118 @@ def _run_grid_bench(check_baseline=None):
     return 0
 
 
+def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
+    """``--serve-bench [N]``: the resident-service amortization bench.  N
+    queries stream through ONE JoinSession on host CPU; query 0 pays mesh
+    bring-up + compilation + the JHIST sizing pre-pass, every later
+    same-shape query warm-starts from the session's hot capacity cache.
+    Prints one BENCH JSON line whose headline ``value`` is warm
+    queries/sec and whose SLO tags (slo_p99_ms, admission_rejection_rate,
+    ...) gate direction-aware under tools_check_regress.py.
+
+    ``--serve-chaos`` arms a mid-stream burst of 3 consecutive
+    ``backend.dispatch`` outages: the breaker must trip, serve the next
+    queries degraded on the CPU-fallback engine, recover through a
+    half-open probe, and END CLOSED — with every outcome classified.
+    Exit 3 on any unclassified outcome, silent wrong count, or a chaos
+    run that fails to trip+recover."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    from tpu_radix_join.core.config import JoinConfig, ServiceConfig
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.robustness.faults import TransientFault
+    from tpu_radix_join.service import UNCLASSIFIED, JoinSession, QueryRequest
+
+    cfg = JoinConfig(num_nodes=8)
+    svc = ServiceConfig(breaker_threshold=3, breaker_cooldown_s=0.05)
+    meas = Measurements(node_id=0, num_nodes=8)
+    session = JoinSession(cfg, svc, measurements=meas)
+
+    burst_at = queries // 2
+    inj = faults.FaultInjector(seed=7, measurements=meas)
+    if chaos:
+        # three consecutive primary-dispatch outages mid-stream: exactly
+        # the breaker threshold, so the trip happens ON the burst
+        inj.arm(faults.BACKEND_DISPATCH,
+                at=tuple(range(burst_at, burst_at + 3)),
+                exc=TransientFault)
+
+    outcomes = []
+    ctx = inj if chaos else contextlib.nullcontext()
+    with ctx:
+        for i in range(queries):
+            session.submit(QueryRequest(query_id=f"q{i}",
+                                        tuples_per_node=1 << 13, seed=17))
+            out = session.run_next()
+            outcomes.append(out)
+            if chaos and out.latency_ms < 50:
+                time.sleep(0.02)     # let the open-state cooldown elapse
+    summary = session.summary()
+    session.close()
+
+    bad = []
+    for o in outcomes:
+        if o.failure_class == UNCLASSIFIED:
+            bad.append(f"{o.query_id}: unclassified outcome")
+        if (o.status == "ok" and o.expected is not None
+                and o.matches != o.expected):
+            bad.append(f"{o.query_id}: silent wrong count {o.matches} != "
+                       f"{o.expected}")
+    if chaos:
+        if summary["breaker_trips"] < 1:
+            bad.append("chaos burst did not trip the breaker")
+        if summary["breaker_probes"] < 1:
+            bad.append("breaker never dispatched a half-open probe")
+        if summary["breaker_state"] != "closed":
+            bad.append(f"breaker ended {summary['breaker_state']}, "
+                       f"not closed")
+        if summary["degraded_queries"] < 1:
+            bad.append("no query served degraded while open")
+    if bad:
+        for b in bad:
+            print(f"ERROR: {b}", file=sys.stderr)
+        return 3
+
+    cold_ms = outcomes[0].latency_ms
+    warm = sorted(o.latency_ms for o in outcomes if o.warm)
+    warm_p50 = warm[len(warm) // 2] if warm else float("nan")
+    warm_qps = (len(warm) / (sum(warm) / 1e3)) if warm else 0.0
+    for o in outcomes:
+        print(f"note: {o.query_id} {o.status}/{o.failure_class} "
+              f"{o.latency_ms:.1f} ms engine={o.engine}"
+              f"{' warm' if o.warm else ''} breaker={o.breaker_state}",
+              file=sys.stderr)
+    result = {
+        "metric": "resident_join_service",
+        "value": round(warm_qps, 3),
+        "unit": "queries/sec",
+        "queries": queries,
+        "cold_latency_ms": round(cold_ms, 3),
+        "warm_latency_p50_ms": round(warm_p50, 3),
+        "warm_speedup": round(cold_ms / warm_p50, 2) if warm else 0.0,
+        "warm_queries": summary["warm_queries"],
+        "degraded_queries": summary["degraded_queries"],
+        "breaker_trips": summary["breaker_trips"],
+        "breaker_probes": summary["breaker_probes"],
+        "admission_rejection_rate": summary["admission_rejection_rate"],
+        "deadline_miss_rate": summary["deadline_miss_rate"],
+        "degraded_rate": summary["degraded_rate"],
+        "slo_p50_ms": summary.get("slo_p50_ms"),
+        "slo_p95_ms": summary.get("slo_p95_ms"),
+        "slo_p99_ms": summary.get("slo_p99_ms"),
+        "chaos": chaos,
+    }
+    print(json.dumps(result))
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
@@ -331,6 +444,20 @@ def main():
         # like --chaos: CPU-sized, exits before the chip-reservation
         # machinery — it gates the pipelined grid engine, not the chip
         sys.exit(_run_grid_bench(check_baseline))
+    if "--serve-bench" in argv:
+        # resident-service amortization bench (service/session.py):
+        # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse
+        # and breaker recovery semantics, not chip throughput
+        i = argv.index("--serve-bench")
+        queries = 20
+        if i + 1 < len(argv) and argv[i + 1].isdigit():
+            queries = int(argv[i + 1])
+        if queries < 2:
+            print("error: --serve-bench needs at least 2 queries "
+                  "(one cold, one warm)", file=sys.stderr)
+            sys.exit(2)
+        sys.exit(_run_serve_bench(check_baseline, queries=queries,
+                                  chaos="--serve-chaos" in argv))
 
     size = 1 << 24               # 16M tuples per side
     planned = _planned_strategy(size, iters=20)

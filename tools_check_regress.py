@@ -15,6 +15,12 @@ a cost tag grew or a rate tag dropped) and exits
 fresh result — a silently vanished measurement is itself a signal.  The
 comparison logic lives in tpu_radix_join.observability.regress; bench.py
 runs the same check in-process via ``--check-regress BASELINE.json``.
+
+Direction is per-tag and automatic: serve-mode SLO tags are pinned
+lower-is-better (``slo_p99_ms`` and friends are latencies;
+``admission_rejection_rate`` / ``deadline_miss_rate`` / ``degraded_rate``
+regress when they GROW, even though "rate" normally marks a throughput),
+so a ``--serve-bench`` BENCH json gates correctly with no extra flags.
 """
 
 import argparse

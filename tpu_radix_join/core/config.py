@@ -317,3 +317,42 @@ class JoinConfig:
 
     def replace(self, **kw) -> "JoinConfig":
         return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceConfig:
+    """Knobs of the resident join service (tpu_radix_join/service/).
+
+    Lives beside :class:`JoinConfig` because the pair travels together —
+    a session is (how to join) x (how to serve) — but stays a separate
+    dataclass: none of these fields changes the compiled program, so they
+    must never enter plan-cache or checkpoint fingerprints.
+    """
+
+    # --- admission (service/admission.py) --------------------------------
+    max_queue_depth: int = 64        # pending queries across all tenants
+    tenant_quota: int = 8            # in-flight queries per tenant
+
+    # --- deadlines (service/deadline.py) ---------------------------------
+    default_deadline_s: Optional[float] = None   # per-query override wins;
+                                                 # None = unlimited
+
+    # --- circuit breaker (service/breaker.py) ----------------------------
+    breaker_threshold: int = 3       # consecutive backend failures to trip
+    breaker_cooldown_s: float = 30.0  # open -> half-open promotion delay
+
+    def __post_init__(self):
+        if self.max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1")
+        if self.tenant_quota < 1:
+            raise ValueError("tenant_quota must be >= 1")
+        if (self.default_deadline_s is not None
+                and self.default_deadline_s < 0):
+            raise ValueError("default_deadline_s must be >= 0 (or None)")
+        if self.breaker_threshold < 1:
+            raise ValueError("breaker_threshold must be >= 1")
+        if self.breaker_cooldown_s < 0:
+            raise ValueError("breaker_cooldown_s must be >= 0")
+
+    def replace(self, **kw) -> "ServiceConfig":
+        return dataclasses.replace(self, **kw)

@@ -160,6 +160,35 @@ def build_parser() -> argparse.ArgumentParser:
                         "(profiles/*.json) or a JSON path, e.g. one from "
                         "tools_make_report.py --emit-profile or "
                         "planner.calibrate()")
+    p.add_argument("--serve", default=None, metavar="FILE",
+                   help="resident service mode (tpu_radix_join.service): "
+                        "read one JSON query request per line from FILE "
+                        "('-' = stdin), run them all through ONE JoinSession "
+                        "(mesh, compiled programs, and converged capacities "
+                        "stay warm across queries), and print one outcome "
+                        "JSON line per query plus a final summary line with "
+                        "the SLO percentiles")
+    p.add_argument("--serve-batch", type=int, default=1, metavar="N",
+                   help="serve mode: submit N requests before draining "
+                        "(default 1 = closed loop; larger batches exercise "
+                        "queue depth and tenant quotas)")
+    p.add_argument("--serve-queue-depth", type=int, default=64,
+                   help="serve mode: admission queue depth bound "
+                        "(exceeded -> admission_rejected/queue_full)")
+    p.add_argument("--serve-tenant-quota", type=int, default=8,
+                   help="serve mode: max in-flight queries per tenant "
+                        "(exceeded -> admission_rejected/tenant_quota)")
+    p.add_argument("--serve-deadline-s", type=float, default=None,
+                   metavar="SEC",
+                   help="serve mode: default per-query latency budget "
+                        "(requests may override with their own deadline_s; "
+                        "expiry -> deadline_exceeded)")
+    p.add_argument("--breaker-threshold", type=int, default=3,
+                   help="serve mode: consecutive backend failures that trip "
+                        "the circuit breaker onto the degraded CPU engine")
+    p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
+                   help="serve mode: seconds the breaker stays open before "
+                        "half-opening for a primary health probe")
     p.add_argument("--pipeline-repeats", action="store_true",
                    help="dispatch the --repeat joins asynchronously and "
                         "fence once (amortized-throughput methodology, "
@@ -239,6 +268,99 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
     return 1 if (expected is not None and total != expected) else 0
 
 
+def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
+    """Resident service mode: every request in the file flows through ONE
+    :class:`~tpu_radix_join.service.JoinSession` — warm plan/capacity
+    reuse across queries, admission control at the door, per-query
+    deadlines, and a circuit breaker that degrades to the CPU engine when
+    the backend goes dark.  One outcome JSON line per query on stdout,
+    then a summary line carrying the SLO snapshot."""
+    import json as _json
+
+    import jax
+
+    from tpu_radix_join.core.config import ServiceConfig
+    from tpu_radix_join.service import (AdmissionRejected, JoinSession,
+                                        QueryRequest)
+
+    plan_cache = None
+    if args.plan_cache_dir:
+        from tpu_radix_join.planner import PlanCache, load_profile
+        from tpu_radix_join.planner.cache import ManifestMismatch
+
+        plan_cache = PlanCache(args.plan_cache_dir,
+                               load_profile(args.profile),
+                               measurements=meas)
+        try:
+            plan_cache.check_manifest(jax.process_count())
+        except ManifestMismatch as e:
+            print(f"[PLAN] {e}", file=sys.stderr)
+            return 2
+        plan_cache.write_manifest(jax.process_count(),
+                                  rank=jax.process_index())
+
+    svc = ServiceConfig(
+        max_queue_depth=args.serve_queue_depth,
+        tenant_quota=args.serve_tenant_quota,
+        default_deadline_s=args.serve_deadline_s,
+        breaker_threshold=args.breaker_threshold,
+        breaker_cooldown_s=args.breaker_cooldown_s)
+    session = JoinSession(cfg, svc, measurements=meas,
+                          plan_cache=plan_cache, profile=args.profile)
+    if sampler is not None:
+        # heartbeat ticks carry the live SLO/breaker snapshot in serve mode
+        sampler.extra = session._heartbeat_extra
+
+    if args.serve == "-":
+        lines = sys.stdin.read().splitlines()
+    else:
+        with open(args.serve) as f:
+            lines = f.read().splitlines()
+
+    errors = 0
+
+    def emit(out):
+        print(_json.dumps({"event": "outcome", **out.to_json()}), flush=True)
+
+    batch = max(1, args.serve_batch)
+    try:
+        pending = 0
+        for lineno, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            try:
+                obj = _json.loads(line)
+                if not isinstance(obj, dict):
+                    raise ValueError("request must be a JSON object")
+                obj.setdefault("query_id", f"line{lineno}")
+                request = QueryRequest.from_json(obj)
+            except (ValueError, TypeError) as e:
+                # a malformed line is the CLIENT's bug: report it and keep
+                # serving — one bad request must not kill the session
+                errors += 1
+                print(_json.dumps({"event": "request_error",
+                                   "line": lineno, "error": str(e)}),
+                      flush=True)
+                continue
+            try:
+                session.submit(request)
+                pending += 1
+            except AdmissionRejected as e:
+                emit(session.rejection_outcome(request, e))
+            if pending >= batch:
+                session.drain(on_outcome=emit)
+                pending = 0
+        session.drain(on_outcome=emit)
+        summary = session.summary()
+        print(_json.dumps({"event": "summary", **summary}), flush=True)
+        # admission rejections are backpressure working as designed; only
+        # executed-and-failed queries (or unparseable requests) fail the run
+        return 1 if (errors or summary.get("queries_failed", 0)) else 0
+    finally:
+        session.close()
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -248,6 +370,9 @@ def main(argv=None) -> int:
         parser.error("--pipeline-repeats dispatches without intermediate "
                      "fences; the --measure-phases split timers need a "
                      "fence per program — drop one of the two")
+    if args.serve is not None and args.grid_chunk_tuples is not None:
+        parser.error("--serve runs the in-core resident engine; the "
+                     "out-of-core grid is a one-shot mode")
 
     import contextlib
     import os
@@ -311,6 +436,8 @@ def main(argv=None) -> int:
             args.metrics_interval, measurements=meas)
         sampler.start()
     try:
+        if args.serve is not None:
+            return _run_serve(args, cfg, meas, nodes, sampler=sampler)
         return _run_driver(args, cfg, meas, distributed, nodes)
     finally:
         if sampler is not None:

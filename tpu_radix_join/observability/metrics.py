@@ -58,12 +58,18 @@ class MetricsSampler:
     timeline and ``meta["events"]``."""
 
     def __init__(self, path: str, interval_s: float = 1.0,
-                 measurements=None):
+                 measurements=None, extra=None):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
+        if extra is not None and not callable(extra):
+            raise TypeError("extra must be a zero-arg callable or None")
         self.path = path
         self.interval_s = float(interval_s)
         self.measurements = measurements
+        #: zero-arg provider merged into every tick — the serve loop
+        #: passes the session's SLO/breaker snapshot so ``tail -f`` shows
+        #: live percentiles next to the counter registry
+        self.extra = extra
         self.samples_written = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
@@ -91,6 +97,8 @@ class MetricsSampler:
                 rec["times_us"] = {k: round(v, 1)
                                    for k, v in m.times_us.items()}
                 rec["open_phases"] = sorted(m._starts)
+            if self.extra is not None:
+                rec.update(self.extra())
         except Exception as e:     # a sampler tick must never kill the join
             rec["error"] = repr(e)
         return rec

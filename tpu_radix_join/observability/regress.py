@@ -10,9 +10,13 @@ a merge the way the tier-1 tests gate correctness.
 Direction discipline: throughput-like tags (``value``, ``vs_baseline``,
 ``*RATE``, ``*gbps``) regress when they *drop*; everything else — the
 time-tag vocabulary (JTOTAL, JPROC, ``*_ms``, ``*_us``) — regresses when
-it *grows*.  A tag only in the baseline is reported as ``missing`` (a
-silently vanished measurement is itself a signal) but fails the gate only
-under ``strict``.
+it *grows*.  Lower-is-better overrides are checked FIRST: the serve-mode
+SLO tags end in words the higher-better vocabulary would otherwise claim
+(``admission_rejection_rate`` contains "rate", but MORE rejections is
+worse; ``slo_p99_ms`` is a latency), so ``_LOWER_BETTER_SUBSTRINGS``
+pins their direction before the substring scan.  A tag only in the
+baseline is reported as ``missing`` (a silently vanished measurement is
+itself a signal) but fails the gate only under ``strict``.
 """
 
 from __future__ import annotations
@@ -32,12 +36,20 @@ _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
                              # fewer staged chunks / reused sorts = the
                              # pipeline silently fell back to serial work
                              "prefetch", "sortreuse")
+# serve-mode SLO tags that LOOK throughput-like but are costs: rejection /
+# miss / degraded fractions regress when they GROW, and every latency
+# percentile is a time.  Checked before the higher-better scan, so
+# "admission_rejection_rate" is not captured by the "rate" substring.
+_LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
+                            "latency", "p50_ms", "p95_ms", "p99_ms")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
 
 
 def higher_is_better(tag: str) -> bool:
     t = tag.lower()
+    if any(s in t for s in _LOWER_BETTER_SUBSTRINGS):
+        return False
     return (tag in _HIGHER_BETTER
             or any(s in t for s in _HIGHER_BETTER_SUBSTRINGS))
 

@@ -73,8 +73,15 @@ from tpu_radix_join.performance.measurements import (BACKOFFMS, RETRYN, VCHK,
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness import verify as _verify
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
-                                             RETRIES_EXHAUSTED, RetryPolicy,
-                                             classify_diagnostics)
+                                             RETRIES_EXHAUSTED,
+                                             RETRYABLE_SIZING, RetryPolicy,
+                                             classify_diagnostics,
+                                             is_retryable_class)
+
+#: the engine's regrow loop only reruns what bigger shapes can fix — a
+#: transient tunnel outage must fall through to the caller (the service's
+#: circuit breaker), not spin the capacity doubler
+_SIZING_POLICY = RetryPolicy(retryable_classes=RETRYABLE_SIZING)
 
 
 class JoinResult(NamedTuple):
@@ -133,6 +140,11 @@ class HashJoin:
                 f"{config.num_nodes}")
         self._compiled = {}
         self.measurements = measurements   # performance.Measurements or None
+        # cooperative cancellation hook (service/deadline.py): an optional
+        # ``callable(phase: str)`` consulted between pipeline phases; it
+        # raises (e.g. DeadlineExceeded) to cancel the query between
+        # programs — never mid-dispatch, so device state stays consistent
+        self.cancel = None
         # resolved per join by _resolve_key_range (config.key_range): True
         # routes the 32-bit count probe to the full-range lexicographic
         # discipline instead of the 31-bit packed fast path
@@ -1348,13 +1360,12 @@ class HashJoin:
         """Capacity shortfalls are fixable with bigger static shapes; key or
         conservation violations are not (the reference aborts on everything,
         Debug.h:27-37 — the retry is this framework's shape-specialization
-        answer to runtime-sized windows, SURVEY.md section 7.4 item 1)."""
-        capacity = (diag["shuffle_overflow_r_tuples"]
-                    or diag["shuffle_overflow_s_tuples"]
-                    or diag["local_overflow"] or diag["hot_overflow"])
-        return bool(capacity) and (diag["key_contract_violations"] == 0
-                                   and diag["conservation_violations"] == 0
-                                   and diag["count_overflow_risk"] == 0)
+        answer to runtime-sized windows, SURVEY.md section 7.4 item 1).
+        Routed through the shared policy-driven predicate under a
+        sizing-only policy: classify_diagnostics already ranks fatal flags
+        above capacity, so a key-contract violation in the same attempt
+        never looks retryable."""
+        return is_retryable_class(classify_diagnostics(diag), _SIZING_POLICY)
 
     def _check_key_width(self, r: TupleBatch, s: TupleBatch) -> None:
         """``config.key_bits`` must match the lanes the batches actually
@@ -1449,6 +1460,7 @@ class HashJoin:
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
         self._check_key_width(r, s)
+        self._check_cancel("start")
         m = self.measurements
         # Timer placement mirrors HashJoin.cpp:50-212: JTOTAL spans the whole
         # join; SWINALLOC wraps the sizing pass (whose execution is JHIST and
@@ -1488,6 +1500,7 @@ class HashJoin:
                 r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
+        self._check_cancel("sized")
         # integrity verification (robustness/verify.py): fingerprint the
         # pristine inputs before anything can damage them.  The n==1 sort
         # specialization performs no exchange (nothing to verify against)
@@ -1535,6 +1548,7 @@ class HashJoin:
                      and not self._single_node_sort_probe())
         vchk = None
         for attempt in range(self.config.max_retries + 1):
+            self._check_cancel("probe")
             if use_split:
                 # config.__post_init__ rejects verify + measure_phases, so
                 # verify_on is always False on this branch
@@ -1589,6 +1603,20 @@ class HashJoin:
         self._cache_store_capacities(r, s, cap_r, cap_s, local_slack,
                                      result.ok)
         return result
+
+    def _check_cancel(self, phase: str) -> None:
+        """Consult the cooperative cancellation hook between phases.  On
+        cancellation the open JTOTAL timer is closed first so the aborted
+        query still reports how long it ran before its budget expired."""
+        if self.cancel is None:
+            return
+        try:
+            self.cancel(phase)
+        except BaseException:
+            m = self.measurements
+            if m is not None and "JTOTAL" in m._starts:
+                m.stop("JTOTAL")
+            raise
 
     def _retry_backoff(self, attempt: int) -> None:
         """Optional pause between capacity-grow retries (``JoinConfig``
@@ -1832,6 +1860,7 @@ class HashJoin:
         if r.size % n or s.size % n:
             raise ValueError("relation sizes must divide the mesh size")
         self._check_key_width(r, s)
+        self._check_cancel("start")
         m = self.measurements
         if m:
             m.start("JTOTAL")

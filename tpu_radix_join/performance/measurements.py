@@ -79,6 +79,13 @@ VCHK = "VCHK"              # integrity-verification timing tag (times_us ONLY:
 VCHKN = "VCHKN"            # integrity checksum comparisons performed
 VFAIL = "VFAIL"            # checksum mismatches detected (robustness/verify.py)
 VREPAIR = "VREPAIR"        # damaged partitions recomputed under --verify repair
+QADMIT = "QADMIT"          # queries admitted by the service queue
+QREJECT = "QREJECT"        # queries rejected at admission (depth / quota)
+QDEADLINE = "QDEADLINE"    # queries cancelled by their deadline
+QWARM = "QWARM"            # warm queries (capacity-cache hit: no sizing pass)
+QDEGRADED = "QDEGRADED"    # queries served by the degraded fallback engine
+BRKTRIP = "BRKTRIP"        # circuit-breaker trips (closed/half-open -> open)
+BRKPROBE = "BRKPROBE"      # half-open health probes dispatched
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

@@ -64,6 +64,18 @@ class PlanCache:
         self.cache_dir = cache_dir
         self.profile = profile
         self.measurements = measurements
+        # in-process hot layer (resident sessions, service/session.py):
+        # repeated same-shape queries inside one process resolve from
+        # memory — no JSON re-parse, no fingerprint re-check — while the
+        # disk entry remains the cross-process/cold-start truth.  Keyed by
+        # entry path, so the fingerprint discipline is inherited: a
+        # different profile or config hashes to a different path.  Each
+        # hot entry carries the (mtime_ns, size) of the disk file it was
+        # parsed from; a cheap stat on every hot hit keeps it coherent
+        # with external writers (another PlanCache over the same dir,
+        # corruption) — an out-of-date hot entry falls back to the disk
+        # path and its stale/corrupt handling, never serves stale data.
+        self._hot: dict = {}
         os.makedirs(cache_dir, exist_ok=True)
 
     # ------------------------------------------------------------- keys
@@ -72,6 +84,14 @@ class PlanCache:
                     config_fp: dict) -> dict:
         return {"r_tuples": int(r_tuples), "s_tuples": int(s_tuples),
                 "config": config_fp}
+
+    @staticmethod
+    def _stat_sig(path: str):
+        try:
+            st = os.stat(path)
+        except OSError:
+            return None
+        return (st.st_mtime_ns, st.st_size)
 
     def _entry(self, key_fields: dict) -> CheckpointManager:
         digest = hashlib.sha256(
@@ -91,6 +111,20 @@ class PlanCache:
         entry must degrade to a cold start, never a wrong warm one."""
         entry = self._entry(self._key_fields(r_tuples, s_tuples, config_fp))
         m = self.measurements
+        if entry.path in self._hot:
+            plan, caps, sig = self._hot[entry.path]
+            if sig == self._stat_sig(entry.path):
+                if m is not None:
+                    m.event("plan_cache_hit", path=entry.path, hot=True,
+                            strategy=plan.strategy if plan else None,
+                            warm_capacities=caps is not None)
+                return plan, caps
+            # disk changed underneath us: re-validate the slow way
+            del self._hot[entry.path]
+        # stat BEFORE the load: if a writer lands between the two, the
+        # recorded signature is older than the content and the next hot
+        # hit falls back to disk — conservative, never stale
+        sig = self._stat_sig(entry.path)
         try:
             state = entry.load()
         except CheckpointMismatch as e:
@@ -109,8 +143,9 @@ class PlanCache:
                             error=repr(e))
                 return None, None
         caps = state.get("capacities")
+        self._hot[entry.path] = (plan, caps, sig)
         if m is not None:
-            m.event("plan_cache_hit", path=entry.path,
+            m.event("plan_cache_hit", path=entry.path, hot=False,
                     strategy=plan.strategy if plan else None,
                     warm_capacities=caps is not None)
         return plan, caps
@@ -138,7 +173,20 @@ class PlanCache:
             state["plan"] = plan.to_dict()
         if capacities is not None:
             state["capacities"] = {k: int(v) for k, v in capacities.items()}
-        return entry.save(state, done=True)
+        # keep the hot layer coherent with what just hit (or failed to hit)
+        # the disk: the merged state is what a fresh lookup would parse
+        hot_plan, hot_caps, _ = self._hot.get(entry.path, (None, None, None))
+        if plan is not None:
+            hot_plan = plan
+        if capacities is not None:
+            hot_caps = dict(state["capacities"])
+        ok = entry.save(state, done=True)
+        if ok:
+            self._hot[entry.path] = (hot_plan, hot_caps,
+                                     self._stat_sig(entry.path))
+        else:
+            self._hot.pop(entry.path, None)
+        return ok
 
     # ---------------------------------------------------------- manifest
 

@@ -176,7 +176,11 @@ class ChaosRunner:
                 faults.check(faults.DEVICE_INIT, m)
                 result = self.engine.join_arrays(*self._batches())
         except faults.InjectedFault as e:
-            cls = _SITE_CLASSES.get(e.site)
+            # the exception's own class wins (TransientFault carries
+            # backend_unavailable); the site table covers the bare
+            # InjectedFault sites
+            cls = getattr(e, "failure_class", None) or _SITE_CLASSES.get(
+                e.site)
             if cls is None:
                 return RunOutcome(schedule, VIOLATION, None, None,
                                   f"unclassified injected fault: {e!r}")
@@ -224,6 +228,135 @@ def soak(runs: int, base_seed: int = 0, runner: Optional[ChaosRunner] = None,
         "violations": sum(o.status == VIOLATION for o in outcomes),
         "failure_classes": sorted({o.failure_class for o in outcomes
                                    if o.failure_class}),
+    }
+    return outcomes, summary
+
+
+#: sites a resident serve loop consults per query: the per-query dispatch
+#: outage (service/session.py) plus the engine-interior sites join_arrays
+#: hits — a session soak exercises breaker trips and engine failures in
+#: the same stream
+SESSION_SITES: Tuple[str, ...] = (
+    faults.BACKEND_DISPATCH,
+    faults.SHUFFLE_OVERFLOW,
+    faults.EXCHANGE_CORRUPT,
+)
+
+
+def generate_session_schedule(seed: int, queries: int = 6) -> Schedule:
+    """1-3 arms over :data:`SESSION_SITES`, each firing at a seeded query
+    index within the stream (every session site is consulted once per
+    query, so the hit index IS the query index)."""
+    rng = random.Random(seed)
+    sites = rng.sample(SESSION_SITES, rng.randint(1, len(SESSION_SITES)))
+    arms = []
+    for site in sites:
+        arms.append((site, (("at", rng.randint(1, max(1, queries - 1))),)))
+    return Schedule(seed=seed, arms=tuple(arms))
+
+
+class SessionChaosRunner:
+    """Executes fault schedules against a resident :class:`JoinSession`.
+
+    Each ``run`` streams ``queries`` requests through ONE freshly built
+    session while the schedule's arms fire at seeded query indices.  The
+    soak invariant is the service's failure-isolation contract: **every
+    query ends in a classified outcome and the session survives the whole
+    stream** — a query that dies unclassified, a silent wrong count, or an
+    exception escaping the serve loop is a VIOLATION.  The breaker is
+    configured aggressively (threshold 1, zero cooldown) so a single
+    armed ``backend.dispatch`` outage exercises the full
+    trip -> degraded-serve -> half-open-probe -> close cycle inside one
+    short stream.
+    """
+
+    def __init__(self, num_nodes: int = 4, size: int = 1 << 12,
+                 verify: str = "check", queries: int = 6,
+                 data_seed: int = 0,
+                 config_overrides: Optional[Dict[str, Any]] = None):
+        from tpu_radix_join.core.config import JoinConfig, ServiceConfig
+        from tpu_radix_join.performance.measurements import Measurements
+        self._measurements_cls = Measurements
+        self.size = size
+        self.queries = queries
+        self.data_seed = data_seed
+        self.config = JoinConfig(num_nodes=num_nodes, verify=verify,
+                                 **(config_overrides or {}))
+        self.service = ServiceConfig(breaker_threshold=1,
+                                     breaker_cooldown_s=0.0)
+        self.measurements: List[Any] = []   # one registry per run, in order
+
+    def run(self, schedule: Schedule) -> RunOutcome:
+        from tpu_radix_join.service import (UNCLASSIFIED, JoinSession,
+                                            QueryRequest)
+        m = self._measurements_cls()
+        self.measurements.append(m)
+        inj = faults.FaultInjector(seed=schedule.seed, measurements=m)
+        for site, kw in schedule.arm_dicts():
+            inj.arm(site, **kw)
+        session = JoinSession(self.config, self.service, measurements=m)
+        outs = []
+        try:
+            with inj:
+                for i in range(self.queries):
+                    request = QueryRequest(
+                        query_id=f"q{i}", tuples_per_node=self.size,
+                        seed=self.data_seed)
+                    session.submit(request)
+                    outs.append(session.run_next())
+        except Exception as e:      # noqa: BLE001 — the invariant itself
+            return RunOutcome(schedule, VIOLATION, None, None,
+                              f"session died at query {len(outs)}: {e!r}")
+        finally:
+            session.close()
+        detail = " ".join(f"{o.query_id}={o.status}/{o.failure_class}"
+                          for o in outs)
+        for o in outs:
+            if o.failure_class == UNCLASSIFIED:
+                return RunOutcome(schedule, VIOLATION, None, o.matches,
+                                  f"unclassified query outcome: {detail}")
+            if (o.status == "ok" and o.expected is not None
+                    and o.matches != o.expected):
+                return RunOutcome(
+                    schedule, VIOLATION, None, o.matches,
+                    f"silent wrong count on {o.query_id}: {o.matches} != "
+                    f"oracle {o.expected} ({detail})")
+        classes = sorted({o.failure_class for o in outs
+                          if o.failure_class != "ok"})
+        last_ok = next((o.matches for o in reversed(outs)
+                        if o.status == "ok"), None)
+        if not classes:
+            return RunOutcome(schedule, PASS, None, last_ok, detail)
+        return RunOutcome(schedule, CLASSIFIED, ",".join(classes),
+                          last_ok, detail)
+
+
+def soak_session(runs: int, base_seed: int = 0,
+                 runner: Optional[SessionChaosRunner] = None,
+                 verify: str = "check",
+                 on_outcome: Optional[Callable[[RunOutcome], None]] = None):
+    """N seeded session streams (:func:`generate_session_schedule`) through
+    one :class:`SessionChaosRunner`; same return shape as :func:`soak`.
+    A violating schedule shrinks with the same :func:`shrink` (the
+    session runner's decisions are seed-deterministic too)."""
+    runner = runner or SessionChaosRunner(verify=verify)
+    outcomes = []
+    for i in range(runs):
+        out = runner.run(generate_session_schedule(base_seed + i,
+                                                   runner.queries))
+        outcomes.append(out)
+        if on_outcome:
+            on_outcome(out)
+    summary = {
+        "runs": runs,
+        "base_seed": base_seed,
+        "verify": runner.config.verify,
+        "queries_per_run": runner.queries,
+        "pass": sum(o.status == PASS for o in outcomes),
+        "classified": sum(o.status == CLASSIFIED for o in outcomes),
+        "violations": sum(o.status == VIOLATION for o in outcomes),
+        "failure_classes": sorted({c for o in outcomes if o.failure_class
+                                   for c in o.failure_class.split(",")}),
     }
     return outcomes, summary
 

@@ -25,6 +25,33 @@ import numpy as np
 from tpu_radix_join.robustness.retry import DEVICE_UNAVAILABLE
 
 
+def build_cpu_engine(config, measurements=None, plan_cache=None
+                     ) -> Tuple[object, dict]:
+    """Construct a ``HashJoin`` over the host CPU devices, shrinking
+    ``num_nodes`` to the available CPU count and collapsing ``num_hosts``
+    to 1 (a degraded run is local by definition).
+
+    This is the shared fallback recipe: ``engine_with_cpu_fallback`` uses
+    it at construction time, and the service's circuit breaker
+    (service/session.py) uses it at query time to keep serving while the
+    chip backend is open-circuited.  Returns (engine, info) where info
+    carries ``num_nodes`` and ``backend="cpu"``.  CPU-construction
+    failures propagate: with no device anywhere there is nothing to
+    degrade to.
+    """
+    from jax.sharding import Mesh
+
+    from tpu_radix_join.operators.hash_join import HashJoin
+
+    cpu = jax.devices("cpu")
+    n = min(config.num_nodes, len(cpu))
+    cfg = config.replace(num_nodes=n, num_hosts=1)
+    cpu_mesh = Mesh(np.asarray(cpu[:n]), (cfg.mesh_axis,))
+    engine = HashJoin(cfg, mesh=cpu_mesh, measurements=measurements,
+                      plan_cache=plan_cache)
+    return engine, {"backend": "cpu", "num_nodes": n}
+
+
 def engine_with_cpu_fallback(config, measurements=None, mesh=None
                              ) -> Tuple[object, dict]:
     """(engine, info): a constructed ``HashJoin`` plus how it was obtained.
@@ -38,8 +65,6 @@ def engine_with_cpu_fallback(config, measurements=None, mesh=None
     CPU-construction failures propagate: with no device anywhere there is
     nothing to degrade to.
     """
-    from jax.sharding import Mesh
-
     from tpu_radix_join.operators.hash_join import HashJoin
 
     try:
@@ -49,11 +74,8 @@ def engine_with_cpu_fallback(config, measurements=None, mesh=None
     except Exception as e:   # noqa: BLE001 — any init failure degrades
         primary_error = e
 
-    cpu = jax.devices("cpu")
-    n = min(config.num_nodes, len(cpu))
-    cfg = config.replace(num_nodes=n, num_hosts=1)
-    cpu_mesh = Mesh(np.asarray(cpu[:n]), (cfg.mesh_axis,))
-    engine = HashJoin(cfg, mesh=cpu_mesh, measurements=measurements)
+    engine, cpu_info = build_cpu_engine(config, measurements=measurements)
+    n = cpu_info["num_nodes"]
     info = {"degraded": True, "backend": "cpu",
             "failure_class": DEVICE_UNAVAILABLE,
             "num_nodes": n, "error": repr(primary_error)}

@@ -33,6 +33,7 @@ import warnings
 from typing import Dict, List, Optional, Tuple
 
 from tpu_radix_join.performance.measurements import FINJECT
+from tpu_radix_join.robustness.retry import BACKEND_UNAVAILABLE
 
 # ---------------------------------------------------------------- site names
 SHUFFLE_OVERFLOW = "engine.shuffle_overflow"   # shuffle-block capacity loss
@@ -44,10 +45,12 @@ STREAM_CORRUPT = "stream.corrupt_lane"         # sentinel-damaged key lane
 EXCHANGE_CORRUPT = "exchange.corrupt_lane"     # bit-flipped key post-exchange
 CKPT_SAVE = "checkpoint.save"                  # checkpoint write I/O error
 CKPT_LOAD = "checkpoint.load"                  # checkpoint read I/O error
+BACKEND_DISPATCH = "backend.dispatch"          # per-query tunnel outage
+                                               # (service/session.py probe)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
-         CKPT_LOAD)
+         CKPT_LOAD, BACKEND_DISPATCH)
 
 
 class InjectedFault(RuntimeError):
@@ -64,7 +67,12 @@ class InjectedKill(InjectedFault):
 
 
 class TransientFault(InjectedFault):
-    """Simulated transient error (tunnel hiccup): safe to retry."""
+    """Simulated transient error (tunnel hiccup): safe to retry.  Carries
+    the transient infrastructure class so the shared retryability
+    predicate (retry.is_retryable_class) and the service's circuit
+    breaker classify it without type-sniffing."""
+
+    failure_class = BACKEND_UNAVAILABLE
 
 
 class _Arm:

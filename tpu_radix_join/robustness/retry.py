@@ -36,9 +36,15 @@ and surfaced by main.py / bench reports):
   * ``retries_exhausted``    — a retryable class persisted through every
     attempt (possibly after a failed fallback).
   * ``backend_unavailable``  — the requested JAX backend never became
-    reachable within the wait budget (bench.py's pre-flight); distinct
-    from ``device_unavailable`` (init *failed*) because the remedy is
+    reachable within the wait budget (bench.py's pre-flight, the
+    service's per-query dispatch probe); distinct from
+    ``device_unavailable`` (init *failed*) because the remedy is
     "retry later / check the tunnel", not "fall back to CPU".
+  * ``admission_rejected``   — the resident service refused the query at
+    the door (queue depth or per-tenant quota, service/admission.py).
+    The query never ran; resubmitting later is safe by construction.
+  * ``deadline_exceeded``    — the query's latency budget expired between
+    pipeline phases (service/deadline.py cooperative cancellation).
 """
 
 from __future__ import annotations
@@ -63,6 +69,8 @@ INTERRUPTED = "interrupted"
 CHECKPOINT_MISMATCH = "checkpoint_mismatch"
 RETRIES_EXHAUSTED = "retries_exhausted"
 BACKEND_UNAVAILABLE = "backend_unavailable"
+ADMISSION_REJECTED = "admission_rejected"
+DEADLINE_EXCEEDED = "deadline_exceeded"
 
 #: diagnostics flags -> class, in priority order (fatal classes outrank
 #: capacity: a key-contract violation must never look retryable just because
@@ -87,9 +95,31 @@ def classify_diagnostics(diag: dict) -> str:
     return OK
 
 
-def is_retryable_class(failure_class: str) -> bool:
-    """Only capacity shortfalls are fixed by regrow-and-rerun."""
-    return failure_class == CAPACITY_OVERFLOW
+#: classes a same-config rerun can plausibly fix.  Two families:
+#:   * sizing — regrow-and-rerun repairs it (the engine's capacity loop);
+#:   * transient infrastructure — nothing is wrong with the query, the
+#:     substrate hiccupped (grid ``TransientFault`` pairs, a probe-phase
+#:     tunnel outage): re-dispatch later on the same shapes.
+#: Everything else (key contracts, conservation, corruption, admission /
+#: deadline verdicts) is fatal for the attempt: retrying cannot fix data,
+#: and retrying a rejected or expired query would double-bill its tenant.
+RETRYABLE_SIZING = frozenset({CAPACITY_OVERFLOW})
+RETRYABLE_TRANSIENT = frozenset({BACKEND_UNAVAILABLE, COORDINATOR_TIMEOUT})
+DEFAULT_RETRYABLE = RETRYABLE_SIZING | RETRYABLE_TRANSIENT
+
+
+def is_retryable_class(failure_class: str,
+                       policy: Optional["RetryPolicy"] = None) -> bool:
+    """Policy-driven retryability predicate, shared by the engine's
+    capacity loop, the grid's transient-pair retries, and the service's
+    dispatch path.  Without a policy the :data:`DEFAULT_RETRYABLE` set
+    applies; a :class:`RetryPolicy` narrows or widens it through its
+    ``retryable_classes`` field (e.g. the engine's regrow loop passes a
+    sizing-only policy — a tunnel outage must fall through to the breaker,
+    not spin the capacity doubler)."""
+    classes = policy.retryable_classes if policy is not None \
+        else DEFAULT_RETRYABLE
+    return failure_class in classes
 
 
 @dataclass(frozen=True)
@@ -116,6 +146,8 @@ class RetryPolicy:
     jitter: float = 0.0
     seed: int = 0
     max_elapsed_s: Optional[float] = None
+    #: failure classes :func:`is_retryable_class` accepts under this policy
+    retryable_classes: frozenset = DEFAULT_RETRYABLE
 
     def delay_s(self, attempt: int) -> float:
         d = min(self.max_delay_s,
@@ -155,17 +187,30 @@ def execute(fn: Callable, policy: RetryPolicy, *,
     """Call ``fn()`` under ``policy``.
 
     Exceptions in ``retryable`` trigger backoff-and-retry (``RETRYN`` and
-    ``BACKOFFMS`` counters + a ``retry`` trace event per attempt); anything
-    else propagates immediately.  When attempts or the ``max_elapsed_s``
-    budget run out, raises :class:`RetriesExhausted` chaining the last
-    error.  ``sleep``/``clock`` are injectable for fake-clock tests.
+    ``BACKOFFMS`` counters + a ``retry`` trace event per attempt), as does
+    any exception whose ``failure_class`` satisfies
+    :func:`is_retryable_class` under ``policy`` — the one predicate the
+    engine's capacity loop, the grid's transient-pair retries, and the
+    service's dispatch path all share.  Anything else propagates
+    immediately.  When attempts or the ``max_elapsed_s`` budget run out,
+    raises :class:`RetriesExhausted` chaining the last error.
+    ``sleep``/``clock`` are injectable for fake-clock tests.
     """
+
+    def _should_retry(e: BaseException) -> bool:
+        if isinstance(e, retryable):
+            return True
+        cls = getattr(e, "failure_class", None)
+        return cls is not None and is_retryable_class(cls, policy)
+
     t0 = clock()
     last: Optional[BaseException] = None
     for attempt in range(policy.max_attempts):
         try:
             return fn()
-        except retryable as e:
+        except Exception as e:
+            if not _should_retry(e):
+                raise
             last = e
             out_of_time = (policy.max_elapsed_s is not None
                            and clock() - t0 >= policy.max_elapsed_s)

@@ -1,0 +1,35 @@
+"""Resident join service: admission-controlled sessions with deadlines,
+a backend circuit breaker, and per-query failure isolation.
+
+Public surface:
+
+  * :class:`JoinSession` / :class:`QueryRequest` / :class:`QueryOutcome`
+    — the resident engine and its per-query verdicts (session.py);
+  * :class:`AdmissionQueue` / :class:`AdmissionRejected` — the bounded,
+    per-tenant front door (admission.py);
+  * :class:`Deadline` / :class:`DeadlineExceeded` — cooperative
+    per-query budgets (deadline.py);
+  * :class:`CircuitBreaker` — closed/open/half-open routing over the
+    chip backend (breaker.py);
+  * :class:`SLORecorder` — per-tenant latency percentiles and outcome
+    rates (slo.py).
+"""
+
+from tpu_radix_join.service.admission import (AdmissionQueue,
+                                              AdmissionRejected)
+from tpu_radix_join.service.breaker import (CLOSED, HALF_OPEN, OPEN,
+                                            CircuitBreaker)
+from tpu_radix_join.service.deadline import Deadline, DeadlineExceeded
+from tpu_radix_join.service.session import (BackendUnavailable, JoinSession,
+                                            QueryOutcome, QueryRequest,
+                                            UNCLASSIFIED)
+from tpu_radix_join.service.slo import SLORecorder, nearest_rank
+
+__all__ = [
+    "AdmissionQueue", "AdmissionRejected",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "Deadline", "DeadlineExceeded",
+    "JoinSession", "QueryRequest", "QueryOutcome", "BackendUnavailable",
+    "UNCLASSIFIED",
+    "SLORecorder", "nearest_rank",
+]

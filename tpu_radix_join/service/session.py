@@ -1,0 +1,422 @@
+"""JoinSession: the resident, admission-controlled join service.
+
+The one-shot driver (main.py) pays mesh bring-up, XLA compilation, the
+JHIST sizing pre-pass, and a ~5-8 ms dispatch tunnel round-trip on EVERY
+invocation, and a backend outage mid-run can only be reported, not
+absorbed.  A :class:`JoinSession` keeps all of that warm across many
+queries:
+
+  * the **mesh and compiled executables** — ``HashJoin`` caches compiled
+    programs per (shape, capacity) key, so same-shape queries after the
+    first skip compilation entirely;
+  * the **plan cache** (planner/cache.py) — the first query's converged
+    window capacities warm-start every later same-shape query past the
+    sizing pre-pass (no JHIST dispatch), via the cache's new in-process
+    hot layer;
+  * **placed relations** — a small LRU of device-resident inputs, so the
+    closed-loop bench's repeated workloads skip generation + transfer.
+
+In front of the engine sit the robustness pieces this module composes
+(each one classified, none of them able to take the session down):
+
+  * :class:`~tpu_radix_join.service.admission.AdmissionQueue` — bounded
+    depth + per-tenant quotas -> ``admission_rejected``;
+  * :class:`~tpu_radix_join.service.deadline.Deadline` — per-query
+    budgets enforced cooperatively between phases (the engine's
+    ``cancel`` hook) -> ``deadline_exceeded``;
+  * :class:`~tpu_radix_join.service.breaker.CircuitBreaker` — consecutive
+    backend failures trip the session onto the degraded CPU engine
+    (robustness/degrade.py machinery); half-open probes recover it;
+  * per-query **failure isolation** — every exception inside a query is
+    caught, classified via the ``failure_class`` taxonomy, and turned
+    into a :class:`QueryOutcome`; only session-construction errors and
+    interrupts propagate.
+
+``main.py --serve`` feeds it from a JSONL request file; ``bench.py
+--serve-bench`` closes the loop and gates the SLO tags.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+from tpu_radix_join.core.config import JoinConfig, ServiceConfig
+from tpu_radix_join.performance.measurements import (JHIST, QDEADLINE,
+                                                     QDEGRADED, QWARM)
+from tpu_radix_join.robustness import faults as _faults
+from tpu_radix_join.robustness.retry import (BACKEND_UNAVAILABLE,
+                                             DEADLINE_EXCEEDED, OK)
+from tpu_radix_join.service.admission import AdmissionQueue, AdmissionRejected
+from tpu_radix_join.service.breaker import HALF_OPEN, CircuitBreaker
+from tpu_radix_join.service.deadline import Deadline, DeadlineExceeded
+from tpu_radix_join.service.slo import SLORecorder
+
+#: unclassified-exception sentinel: a query that dies without a
+#: failure_class still yields a terminal outcome (the session survives),
+#: but chaos/soak treats this string as an isolation violation
+UNCLASSIFIED = "unclassified"
+
+_PLACE_CACHE_MAX = 8     # placed-relation LRU entries (device memory bound)
+
+
+class BackendUnavailable(ConnectionError):
+    """The chip backend failed a query-time dispatch (tunnel outage)."""
+
+    failure_class = BACKEND_UNAVAILABLE
+
+
+@dataclasses.dataclass(frozen=True)
+class QueryRequest:
+    """One join request as the serve loop admits it (JSONL line shape)."""
+
+    query_id: str
+    tenant: str = "default"
+    tuples_per_node: int = 1 << 16
+    outer_kind: str = "unique"          # unique | modulo | zipf
+    modulo: Optional[int] = None
+    zipf_theta: float = 0.75
+    seed: int = 1234
+    repeats: int = 1
+    deadline_s: Optional[float] = None  # None -> ServiceConfig default
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "QueryRequest":
+        fields = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(obj) - fields
+        if unknown:
+            raise ValueError(f"unknown request fields: {sorted(unknown)}")
+        if "query_id" not in obj:
+            raise ValueError("request needs a query_id")
+        return cls(**obj)
+
+
+@dataclasses.dataclass
+class QueryOutcome:
+    """Terminal, classified verdict for one submitted query."""
+
+    query_id: str
+    tenant: str
+    status: str                     # ok | failed | rejected
+    failure_class: str              # "ok" when status == "ok"
+    latency_ms: float
+    matches: Optional[int] = None
+    expected: Optional[int] = None
+    engine: str = "primary"         # primary | cpu_fallback
+    degraded: bool = False
+    warm: bool = False              # sizing pre-pass skipped (cache hit)
+    breaker_state: str = "closed"
+    detail: str = ""
+
+    def to_json(self) -> dict:
+        out = dataclasses.asdict(self)
+        out["latency_ms"] = round(self.latency_ms, 3)
+        return out
+
+
+class JoinSession:
+    """Resident engine + admission queue + breaker + SLO accounting.
+
+    Single-threaded by design: one mesh, one query at a time (the
+    micro-batching direction in ROADMAP item 1 layers onto this API).
+    Construction builds the primary engine once; ``submit``/``run_next``/
+    ``drain`` serve queries; ``close`` releases everything the session
+    owns (and is idempotent).
+    """
+
+    def __init__(self, config: JoinConfig,
+                 service: Optional[ServiceConfig] = None,
+                 measurements=None, plan_cache=None, profile: str = "v5e_lite",
+                 clock: Callable[[], float] = time.monotonic):
+        from tpu_radix_join.operators.hash_join import HashJoin
+
+        self.config = config
+        self.service = service or ServiceConfig()
+        self.measurements = measurements
+        self._cache_tmp = None
+        if plan_cache is None:
+            # a resident session warms by default: without a caller-provided
+            # cache dir, own an ephemeral one (first same-shape query pays
+            # the sizing pre-pass, every later one skips it via the hot
+            # layer; the tempdir dies with the session)
+            import tempfile
+
+            from tpu_radix_join.planner import PlanCache, load_profile
+            self._cache_tmp = tempfile.TemporaryDirectory(
+                prefix="join_session_plan_cache_")
+            plan_cache = PlanCache(self._cache_tmp.name,
+                                   load_profile(profile),
+                                   measurements=measurements)
+        self.plan_cache = plan_cache
+        self._clock = clock
+        self.queue = AdmissionQueue(self.service.max_queue_depth,
+                                    self.service.tenant_quota,
+                                    measurements=measurements)
+        self.breaker = CircuitBreaker(self.service.breaker_threshold,
+                                      self.service.breaker_cooldown_s,
+                                      clock=clock,
+                                      measurements=measurements)
+        self.slo = SLORecorder()
+        self.engine = HashJoin(config, measurements=measurements,
+                               plan_cache=plan_cache)
+        self._cpu_engine = None         # built lazily on first open-state query
+        self._place_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
+        self._sampler = None            # attached heartbeat, owned if set
+        self._closed = False
+        self.outcomes: List[QueryOutcome] = []
+
+    # ----------------------------------------------------------- admission
+    def submit(self, request: QueryRequest) -> None:
+        """Admit ``request`` or raise :class:`AdmissionRejected` (already
+        SLO-accounted; callers turn it into a rejected outcome via
+        :meth:`rejection_outcome`)."""
+        if self._closed:
+            raise RuntimeError("session is closed")
+        try:
+            self.queue.submit(request)
+        except AdmissionRejected:
+            self.slo.record_rejection()
+            raise
+
+    def rejection_outcome(self, request: QueryRequest,
+                          exc: AdmissionRejected) -> QueryOutcome:
+        out = QueryOutcome(
+            query_id=request.query_id, tenant=request.tenant,
+            status="rejected", failure_class=exc.failure_class,
+            latency_ms=0.0, breaker_state=self.breaker.state,
+            detail=f"{exc.reason}: {exc}")
+        self.outcomes.append(out)
+        return out
+
+    # ------------------------------------------------------------- serving
+    def run_next(self) -> Optional[QueryOutcome]:
+        """Execute the oldest admitted query; None when the queue is
+        empty.  The tenant's quota slot is released on every outcome
+        path."""
+        request = self.queue.pop()
+        if request is None:
+            return None
+        try:
+            return self._execute(request)
+        finally:
+            self.queue.done(request)
+
+    def drain(self, on_outcome: Optional[Callable] = None
+              ) -> List[QueryOutcome]:
+        outs = []
+        while True:
+            out = self.run_next()
+            if out is None:
+                return outs
+            outs.append(out)
+            if on_outcome is not None:
+                on_outcome(out)
+
+    # ------------------------------------------------------------ internals
+    def _degraded_engine(self):
+        """The CPU fallback engine, built once on first use (the breaker's
+        open-state serving path — robustness/degrade.py's construction
+        recipe, reused here for query-time degradation)."""
+        if self._cpu_engine is None:
+            from tpu_radix_join.robustness.degrade import build_cpu_engine
+            self._cpu_engine, info = build_cpu_engine(
+                self.config, measurements=self.measurements,
+                plan_cache=self.plan_cache)
+            m = self.measurements
+            if m is not None:
+                m.event("degrade", to="cpu", num_nodes=info["num_nodes"],
+                        reason="breaker_open")
+        return self._cpu_engine
+
+    def _relations(self, request: QueryRequest):
+        """(inner, outer, expected) for the request's workload — the same
+        construction main.py's one-shot driver uses, sized by the
+        *session* config so primary and degraded engines agree on the
+        global shape."""
+        from tpu_radix_join.data.relation import Relation
+
+        nodes = self.config.num_nodes
+        global_size = request.tuples_per_node * nodes
+        inner = Relation(global_size, nodes, "unique", seed=request.seed)
+        outer_kw = {}
+        if request.outer_kind == "modulo":
+            outer_kw["modulo"] = request.modulo or max(1, global_size // 4)
+        elif request.outer_kind == "zipf":
+            outer_kw["zipf_theta"] = request.zipf_theta
+            outer_kw["key_domain"] = global_size
+        outer = Relation(global_size, nodes, request.outer_kind,
+                         seed=request.seed + 1, **outer_kw)
+        return inner, outer, inner.expected_matches(outer)
+
+    def _place(self, engine, rel, tag: str, request: QueryRequest):
+        """Placed-batch LRU: a resident session re-serving the same
+        workload skips generation + host->device transfer."""
+        key = (id(engine), tag, rel.global_size, rel.kind, request.seed,
+               request.outer_kind, request.modulo, request.zipf_theta)
+        if key in self._place_cache:
+            self._place_cache.move_to_end(key)
+            return self._place_cache[key]
+        batch = engine.place(rel)
+        self._place_cache[key] = batch
+        while len(self._place_cache) > _PLACE_CACHE_MAX:
+            self._place_cache.popitem(last=False)
+        return batch
+
+    def _execute(self, request: QueryRequest) -> QueryOutcome:
+        m = self.measurements
+        svc = self.service
+        budget = (request.deadline_s if request.deadline_s is not None
+                  else svc.default_deadline_s)
+        deadline = Deadline(budget, clock=self._clock)
+        primary = self.breaker.allow_primary()
+        probing = primary and self.breaker.state == HALF_OPEN
+        engine = self.engine if primary else self._degraded_engine()
+        t0 = time.perf_counter()
+        jhist0 = m.times_us.get(JHIST, 0.0) if m is not None else 0.0
+        span = (m.span("query", query_id=request.query_id,
+                       tenant=request.tenant,
+                       engine="primary" if primary else "cpu_fallback",
+                       probe=probing)
+                if m is not None else _null_ctx())
+        engine.cancel = deadline.check
+        status, cls, detail = "ok", OK, ""
+        matches = expected = None
+        try:
+            with span:
+                if primary and _faults.fires(_faults.BACKEND_DISPATCH, m):
+                    # injectable per-query tunnel outage (chaos / tests):
+                    # the production twin is the except-clause mapping of
+                    # raw connection errors below
+                    raise BackendUnavailable(
+                        f"injected backend outage (query "
+                        f"{request.query_id})")
+                deadline.check("admitted")
+                inner, outer, expected = self._relations(request)
+                deadline.check("generated")
+                r_batch = self._place(engine, inner, "r", request)
+                s_batch = self._place(engine, outer, "s", request)
+                deadline.check("placed")
+                result = engine.join_arrays(r_batch, s_batch,
+                                            repeats=request.repeats)
+                matches = result.matches
+                cls = (result.diagnostics or {}).get(
+                    "failure_class") or (OK if result.ok else UNCLASSIFIED)
+                status = "ok" if result.ok else "failed"
+                if status == "failed":
+                    detail = str({k: v for k, v in
+                                  (result.diagnostics or {}).items()
+                                  if k != "failure_class"})[:500]
+        except DeadlineExceeded as e:
+            status, cls, detail = "failed", DEADLINE_EXCEEDED, str(e)
+            if m is not None:
+                m.incr(QDEADLINE)
+        except (KeyboardInterrupt, SystemExit):
+            raise                        # the operator's kill stays a kill
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            status = "failed"
+            cls = getattr(e, "failure_class", None)
+            if cls is None and isinstance(
+                    e, (ConnectionError, TimeoutError, OSError)):
+                # a raw transport error from a dead tunnel is the
+                # production form of backend_unavailable
+                cls = BACKEND_UNAVAILABLE
+            if cls is None:
+                cls = UNCLASSIFIED
+            detail = repr(e)[:500]
+            if m is not None:
+                m.event("query_failed", query_id=request.query_id,
+                        failure_class=cls, error=repr(e)[:200])
+        finally:
+            engine.cancel = None
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        # warm = the sizing pre-pass did not run this query (plan-cache /
+        # hot-layer capacity hit): the observable the acceptance criteria
+        # gate on, measured from the JHIST column's delta
+        warm = (status == "ok" and m is not None
+                and m.times_us.get(JHIST, 0.0) == jhist0
+                and self.slo.completed > 0)
+        if m is not None:
+            if warm:
+                m.incr(QWARM)
+            if not primary:
+                m.incr(QDEGRADED)
+        if primary:
+            if cls == OK:
+                self.breaker.record_success()
+            else:
+                self.breaker.record_failure(cls)
+        out = QueryOutcome(
+            query_id=request.query_id, tenant=request.tenant,
+            status=status, failure_class=cls, latency_ms=latency_ms,
+            matches=matches, expected=expected,
+            engine="primary" if primary else "cpu_fallback",
+            degraded=not primary, warm=warm,
+            breaker_state=self.breaker.state, detail=detail)
+        self.slo.record(request.tenant, latency_ms, ok=(status == "ok"),
+                        failure_class=None if cls == OK else cls,
+                        degraded=not primary)
+        self.outcomes.append(out)
+        return out
+
+    # ----------------------------------------------------------- lifecycle
+    def attach_heartbeat(self, path: str, interval_s: float):
+        """Start a metrics heartbeat owned by this session (stopped by
+        :meth:`close`): every tick carries the SLO snapshot next to the
+        counter registry, so ``tail -f`` shows live percentiles."""
+        from tpu_radix_join.observability import MetricsSampler
+        self._sampler = MetricsSampler(path, interval_s,
+                                       measurements=self.measurements,
+                                       extra=self._heartbeat_extra)
+        self._sampler.start()
+        return self._sampler
+
+    def _heartbeat_extra(self) -> dict:
+        return {"slo": self.slo.snapshot(),
+                "breaker": self.breaker.snapshot(),
+                "queue_depth": self.queue.depth()}
+
+    def summary(self) -> dict:
+        """Final serve report: SLO tags + breaker/queue/cache state."""
+        out = self.slo.snapshot()
+        out.update(breaker_state=self.breaker.state,
+                   breaker_trips=self.breaker.trips,
+                   breaker_probes=self.breaker.probes,
+                   queue_rejected=self.queue.rejected)
+        m = self.measurements
+        if m is not None:
+            out["warm_queries"] = int(m.counters.get(QWARM, 0))
+            out["degraded_queries"] = int(m.counters.get(QDEGRADED, 0))
+        return out
+
+    def close(self) -> None:
+        """Release everything the session owns: the heartbeat sampler
+        thread, placed-batch device references, and the engines' compile
+        caches.  Idempotent; the session refuses new submissions after."""
+        if self._closed:
+            return
+        self._closed = True
+        if self._sampler is not None:
+            self._sampler.stop()
+            self._sampler = None
+        self._place_cache.clear()
+        for eng in (self.engine, self._cpu_engine):
+            if eng is not None:
+                eng._compiled.clear()
+        self._cpu_engine = None
+        if self._cache_tmp is not None:
+            self._cache_tmp.cleanup()
+            self._cache_tmp = None
+
+    def __enter__(self) -> "JoinSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _null_ctx():
+    import contextlib
+    return contextlib.nullcontext()
