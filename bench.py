@@ -296,6 +296,89 @@ def _run_grid_bench(check_baseline=None):
     return 0
 
 
+def _run_exchange_bench(check_baseline=None):
+    """``--exchange-bench``: A/B of the shuffle wire format — raw 8 B/tuple
+    lanes over a fused all_to_all versus the bit-packed codec
+    (data/tuples.py WireSpec) over a 4-group staged exchange
+    (parallel/window.py) — on an 8-way host-CPU mesh with full verification
+    on.  Both arms must be oracle-exact (exit 3 otherwise); the BENCH
+    headline ``value`` is the wire *reduction* ratio (raw bytes/tuple over
+    packed bytes/tuple, higher is better), and the footprint tags
+    (``bytes_per_tuple``, ``peak_exchange_bytes``, ``wirebytes``) gate
+    lower-is-better under tools_check_regress.py."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.operators.hash_join import HashJoin
+    from tpu_radix_join.performance import Measurements
+
+    nodes, per_node = 8, 1 << 17
+    inner = Relation(per_node * nodes, nodes, "unique", seed=21)
+    outer = Relation(per_node * nodes, nodes, "unique", seed=22)
+    expected = inner.expected_matches(outer)
+
+    arms = (("off", dict(exchange_codec="off", exchange_stages=1)),
+            ("pack", dict(exchange_codec="pack", exchange_stages=4)))
+    stats = {}
+    for name, kw in arms:
+        meas = Measurements(node_id=0, num_nodes=nodes)
+        eng = HashJoin(JoinConfig(num_nodes=nodes, verify="check", **kw),
+                       measurements=meas)
+        eng.join(inner, outer)              # warmup: mesh + compile
+        t0 = time.perf_counter()
+        res = eng.join(inner, outer)
+        wall = time.perf_counter() - t0
+        if not res.ok:
+            print(f"ERROR: verification failed (codec={name}): "
+                  f"{res.failure}", file=sys.stderr)
+            sys.exit(3)
+        if expected is not None and res.matches != expected:
+            print(f"ERROR: matches {res.matches} != oracle {expected} "
+                  f"(codec={name})", file=sys.stderr)
+            sys.exit(3)
+        xs = meas.meta.get("exchange_plan")
+        if not xs:
+            print(f"ERROR: no exchange_plan stamped (codec={name})",
+                  file=sys.stderr)
+            sys.exit(3)
+        stats[name] = dict(xs, wall_s=wall)
+        print(f"note: codec={name}: {xs['bytes_per_tuple']:.3f} B/tuple, "
+              f"peak {xs['peak_exchange_bytes']} B/collective, "
+              f"wire {xs['wire_bytes']} B, stages={xs['stages']}, "
+              f"{wall*1e3:.1f} ms wall", file=sys.stderr)
+
+    off, pack = stats["off"], stats["pack"]
+    reduction = off["bytes_per_tuple"] / max(pack["bytes_per_tuple"], 1e-9)
+    peak_speedup = (off["peak_exchange_bytes"]
+                    / max(pack["peak_exchange_bytes"], 1))
+    result = {
+        "metric": "exchange_wire_reduction",
+        "value": round(reduction, 4),
+        "unit": "raw_over_packed_bytes",
+        "vs_baseline": round(reduction, 4),
+        "bytes_per_tuple": round(pack["bytes_per_tuple"], 4),
+        "bytes_per_tuple_raw": round(off["bytes_per_tuple"], 4),
+        "peak_exchange_bytes": pack["peak_exchange_bytes"],
+        "peak_exchange_bytes_raw": off["peak_exchange_bytes"],
+        "peak_speedup": round(peak_speedup, 2),
+        "wirebytes": pack["wire_bytes"],
+        "wirebytes_raw": off["wire_bytes"],
+        "pack_ratio_pct": pack["pack_ratio_pct"],
+        "stages": pack["stages"],
+        "wall_off_ms": round(off["wall_s"] * 1e3, 1),
+        "wall_pack_ms": round(pack["wall_s"] * 1e3, 1),
+    }
+    print(json.dumps(result))
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
     """``--serve-bench [N]``: the resident-service amortization bench.  N
     queries stream through ONE JoinSession on host CPU; query 0 pays mesh
@@ -444,6 +527,11 @@ def main():
         # like --chaos: CPU-sized, exits before the chip-reservation
         # machinery — it gates the pipelined grid engine, not the chip
         sys.exit(_run_grid_bench(check_baseline))
+    if "--exchange-bench" in argv:
+        # wire-format A/B (data/tuples.py codec + parallel/window.py
+        # staging): CPU-sized like --grid-bench — it gates exchange bytes
+        # and the live exchange footprint, not chip throughput
+        sys.exit(_run_exchange_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

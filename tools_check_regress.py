@@ -21,6 +21,15 @@ lower-is-better (``slo_p99_ms`` and friends are latencies;
 ``admission_rejection_rate`` / ``deadline_miss_rate`` / ``degraded_rate``
 regress when they GROW, even though "rate" normally marks a throughput),
 so a ``--serve-bench`` BENCH json gates correctly with no extra flags.
+
+The ``--exchange-bench`` footprint tags are pinned the same way:
+``wirebytes`` (total bytes the all_to_all actually shipped under the
+active codec), ``peak_exchange_bytes`` (largest live allocation of one
+staged collective), and ``bytes_per_tuple`` are lower-is-better — a codec
+or staging change that inflates the wire regresses even when the join
+stays correct and the wall time holds.  The BENCH headline ``value`` is
+the wire *reduction* ratio (raw 8 B per tuple over packed bytes per
+tuple), which keeps the headline higher-is-better like every other bench.
 """
 
 import argparse

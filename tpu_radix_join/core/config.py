@@ -79,6 +79,27 @@ class JoinConfig:
     window_sizing: str = "measured"
     allocation_factor: float = 1.5   # slack multiplier on padded blocks (static
                                      # window sizing + local bucket capacities)
+    # Wire codec for the shuffle exchange (data/tuples.make_wire_spec):
+    #   "off"  — two/three uint32 lanes per tuple on the wire (8/12 B), plus a
+    #            separate per-sender count collective (the pre-codec format).
+    #   "pack" — bounds-aware bit-packed blocks: fanout bits dropped from
+    #            keys (restored positionally from per-partition header
+    #            counts), key remainder and rid packed to the minimum lane
+    #            budget implied by the key bound / relation sizes; the count
+    #            side channel folds into the header, eliminating one
+    #            collective per relation per exchange.
+    #   "auto" — the engine (or the planner) packs only when the packed
+    #            block is actually smaller than the raw lanes.
+    # Note: packing masks key bits above the measured bound, so injected
+    # corruption in those high bits (chaos exchange.corrupt_lane) is healed
+    # rather than detected — keep "off" when chaos-testing lane corruption.
+    exchange_codec: str = "off"
+    # Staged exchange (parallel/window.block_all_to_all): split the [N, C]
+    # block buffer into k column groups exchanged via k smaller sequenced
+    # collectives, bounding live exchange-buffer memory to ~1/k.
+    # 1 = fused single collective; 0 = auto (engine/planner picks by block
+    # size); k > 1 = exactly k stages.
+    exchange_stages: int = 1
 
     # --- policies --------------------------------------------------------------
     assignment_policy: str = "round_robin"   # or "load_aware"
@@ -177,6 +198,14 @@ class JoinConfig:
             raise ValueError("allocation_factor must be >= 1.0")
         if self.window_sizing not in ("measured", "static"):
             raise ValueError(f"unknown window sizing mode {self.window_sizing!r}")
+        if self.exchange_codec not in ("off", "pack", "auto"):
+            raise ValueError(
+                f"unknown exchange codec {self.exchange_codec!r} "
+                "(expected 'off', 'pack', or 'auto')")
+        if self.exchange_stages < 0:
+            raise ValueError(
+                "exchange_stages must be >= 0 (0 = auto, 1 = fused, "
+                "k > 1 = staged)")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if self.fallback not in ("none", "chunked"):

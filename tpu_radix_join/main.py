@@ -63,6 +63,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "data_corruption; 'repair' recomputes only the "
                         "damaged partitions out-of-core and returns the "
                         "corrected count (VREPAIR counter)")
+    p.add_argument("--exchange-codec", choices=["off", "pack", "auto"],
+                   default="off",
+                   help="shuffle wire codec (data/tuples.make_wire_spec): "
+                        "'pack' bit-packs key remainders + rids to the "
+                        "bounds measured by the sizing pre-pass and folds "
+                        "the count side channel into the packed header "
+                        "(one collective per relation per exchange); "
+                        "'auto' packs only when the packed block beats the "
+                        "raw 8/12 B lanes")
+    p.add_argument("--exchange-stages", type=int, default=1, metavar="K",
+                   help="staged exchange (parallel/window.py): split each "
+                        "[N, C] block buffer into K column groups exchanged "
+                        "by K sequenced collectives, bounding live exchange "
+                        "memory to ~1/K.  1 = fused single collective, "
+                        "0 = auto (stage 4-ways once blocks are >= 4096 "
+                        "slots)")
     p.add_argument("--cpu-fallback", action="store_true",
                    help="if device/mesh init fails, rebuild the engine over "
                         "host CPU devices (loud [DEGRADE] warning) instead "
@@ -413,6 +429,8 @@ def main(argv=None) -> int:
         debug_checks=args.debug_checks,
         measure_phases=args.measure_phases,
         verify=args.verify,
+        exchange_codec=args.exchange_codec,
+        exchange_stages=args.exchange_stages,
     )
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)

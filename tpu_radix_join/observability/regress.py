@@ -41,7 +41,14 @@ _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
 # percentile is a time.  Checked before the higher-better scan, so
 # "admission_rejection_rate" is not captured by the "rate" substring.
 _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
-                            "latency", "p50_ms", "p95_ms", "p99_ms")
+                            "latency", "p50_ms", "p95_ms", "p99_ms",
+                            # exchange-codec footprint tags (--exchange-bench
+                            # and the WIREBYTES counter): more bytes on the
+                            # wire or a larger live exchange allocation is
+                            # a codec/staging regression even though the
+                            # join may still pass
+                            "wirebytes", "peak_exchange_bytes",
+                            "bytes_per_tuple")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
 

@@ -21,6 +21,7 @@ observably, can this pipeline.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import time
 from typing import NamedTuple, Optional
@@ -37,6 +38,7 @@ from tpu_radix_join.data.tuples import (
     R_PAD_KEY,
     TupleBatch,
     _sentinel_lane,
+    make_wire_spec,
     partition_ids,
     valid_mask,
 )
@@ -67,7 +69,8 @@ from tpu_radix_join.ops.radix import local_histogram, scatter_to_blocks
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import (network_partition,
                                                           receive_checksums)
-from tpu_radix_join.parallel.window import ExchangeResult, Window
+from tpu_radix_join.parallel.window import (ExchangeResult, Window,
+                                            parse_exchange_mode)
 from tpu_radix_join.performance.measurements import (BACKOFFMS, RETRYN, VCHK,
                                                      VCHKN, VFAIL, VREPAIR)
 from tpu_radix_join.robustness import faults as _faults
@@ -151,6 +154,14 @@ class HashJoin:
         self._full_range = False
         # static key bound hint for "auto" (set by Relation entry points)
         self._static_key_bound: Optional[int] = None
+        # max key observed by this join's sizing pre-pass (the JHIST program
+        # carries a pmax alongside the demand histograms) — feeds the packed
+        # wire codec's key bound when no static Relation bound exists
+        self._measured_key_bound: Optional[int] = None
+        # wire-format plan resolved per join by _resolve_exchange_plan:
+        # (codec, mode, key_bound, rid_bound_r, rid_bound_s).  Part of every
+        # pipeline compile key — the bounds change the lowered program.
+        self._xplan = ("off", 1, None, None, None)
 
     # ------------------------------------------------------------------ build
     def _histogram_fn(self, hot_bits: int = 0):
@@ -204,13 +215,23 @@ class HashJoin:
                                axis=1)
             s_demand = jnp.sum(jnp.where(dest_onehot, s_hist_eff[None, :], 0),
                                axis=1) + spread_demand
+            # max key lanes ride the sizing pass for free (the tuples are
+            # already streaming through): the packed wire codec derives its
+            # key bound from this when no static Relation bound exists.
+            # Per-lane maxes are independent upper bounds, so the wide bound
+            # (max_hi << 32 | max_lo) is valid even when the lane maxes come
+            # from different tuples.
+            kmax_lo = jnp.maximum(jnp.max(r.key), jnp.max(s.key))
+            kmax_hi = (jnp.uint32(0) if r.key_hi is None
+                       else jnp.maximum(jnp.max(r.key_hi), jnp.max(s.key_hi)))
+            keymax = jax.lax.pmax(jnp.stack([kmax_lo, kmax_hi]), ax)
             return (r_demand.astype(jnp.uint32), s_demand.astype(jnp.uint32),
-                    r_ghist, s_ghist, hot_r_count)
+                    r_ghist, s_ghist, hot_r_count, keymax)
 
         spec = P(cfg.mesh_axes)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec),
-            out_specs=(spec, spec, P(), P(), spec)))
+            out_specs=(spec, spec, P(), P(), spec, P())))
 
     def _keys_in_contract(self, r: TupleBatch, s: TupleBatch,
                           materialize: bool = False) -> jnp.ndarray:
@@ -282,7 +303,9 @@ class HashJoin:
                 "key_bits": cfg.key_bits, "two_level": cfg.two_level,
                 "probe_algorithm": cfg.probe_algorithm,
                 "assignment_policy": cfg.assignment_policy,
-                "window_sizing": cfg.window_sizing}
+                "window_sizing": cfg.window_sizing,
+                "exchange_codec": cfg.exchange_codec,
+                "exchange_stages": cfg.exchange_stages}
 
     def _cache_eligible(self) -> bool:
         """Warm-start capacities only apply where the sizing pre-pass would
@@ -337,7 +360,9 @@ class HashJoin:
         if cfg.window_sizing == "static":
             return (cfg.shuffle_block_capacity(r.size // n),
                     cfg.shuffle_block_capacity(s.size // n), None)
-        r_demand, s_demand, r_gh, s_gh, _ = self._run_hist(r, s, 0)
+        r_demand, s_demand, r_gh, s_gh, _, keymax = self._run_hist(r, s, 0)
+        km = self._to_host(keymax)
+        self._measured_key_bound = ((int(km[1]) << 32) | int(km[0])) + 1
 
         def cap(demand):
             worst = max(1, int(self._to_host(demand).max()))
@@ -350,7 +375,7 @@ class HashJoin:
                 num_nodes=n)
             if hot.any():
                 hot_bits = skew.hot_mask_bits(hot)
-                r_demand, s_demand, _, _, hot_counts = self._run_hist(
+                r_demand, s_demand, _, _, hot_counts, _ = self._run_hist(
                     r, s, hot_bits)
                 skew_plan = (hot_bits, cap(hot_counts))
 
@@ -404,8 +429,7 @@ class HashJoin:
         n = cfg.num_nodes
         fanout = cfg.network_fanout_bits
         num_p = cfg.network_partition_count
-        win_r = Window(n, cap_r, ax, "inner")
-        win_s = Window(n, cap_s, ax, "outer")
+        win_r, win_s = self._make_windows(cap_r, cap_s)
 
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = self._keys_in_contract(r, s)
@@ -512,9 +536,7 @@ class HashJoin:
         _materialize_fn."""
         cfg = self.config
         ax = cfg.mesh_axes
-        n = cfg.num_nodes
-        win_r = Window(n, cap_r, ax, "inner")
-        win_s = Window(n, cap_s, ax, "outer")
+        win_r, win_s = self._make_windows(cap_r, cap_s)
 
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = self._keys_in_contract(r, s, materialize=materialize)
@@ -594,6 +616,7 @@ class HashJoin:
         n = self.config.num_nodes
         return (r.size // n, s.size // n, cap_r, cap_s, skew_plan,
                 r.key_hi is None, s.key_hi is None, self._full_range,
+                self._xplan,
                 getattr(r.key, "sharding", None),
                 getattr(s.key, "sharding", None))
 
@@ -1172,9 +1195,7 @@ class HashJoin:
         kernels_optimized.cu:689-787)."""
         cfg = self.config
         ax = cfg.mesh_axes
-        n = cfg.num_nodes
-        win_r = Window(n, cap_r, ax, "inner")
-        win_s = Window(n, cap_s, ax, "outer")
+        win_r, win_s = self._make_windows(cap_r, cap_s)
 
         def body(r: TupleBatch, s: TupleBatch):
             keys_ok = (jnp.max(_sentinel_lane(r)) < R_PAD_KEY) & (
@@ -1219,6 +1240,7 @@ class HashJoin:
         n = self.config.num_nodes
         key = (r.size // n, s.size // n, cap_r, cap_s, local_slack, skew_plan,
                r.key_hi is None, s.key_hi is None, self._full_range, verify,
+               self._xplan,
                getattr(r.key, "sharding", None), getattr(s.key, "sharding", None))
         return self._compile_timed(
             key,
@@ -1410,6 +1432,138 @@ class HashJoin:
         return int(self._to_host(
             self._maxkey_jit(r.key, s.key))) > MAX_MERGE_KEY
 
+    # ------------------------------------------------- exchange wire plan
+    def _resolve_exchange_plan(self, r: TupleBatch, s: TupleBatch):
+        """Resolve ``config.exchange_codec`` / ``exchange_stages`` into this
+        join's concrete wire plan ``(codec, mode, key_bound, rid_bound_r,
+        rid_bound_s)`` — appended to every pipeline compile key, because the
+        bounds change the lowered program (data/tuples.make_wire_spec).
+
+        ``key_bound`` priority: the static Relation bound recorded by
+        :meth:`join`, then the max key the sizing pre-pass measured (the
+        JHIST program carries a pmax alongside the demand histograms), then
+        a one-off device max probe (~2 HBM scans).  All three are exact
+        upper bounds, so packing can never mask a real key bit.  The rid
+        bounds are exact and free: rids are global dense tuple indices
+        (data/relation.py), so each side's relation size bounds its lane.
+
+        ``codec="auto"`` stays "auto" here — whether packing actually beats
+        the raw lanes depends on each window's capacity (header
+        amortization), resolved per side by :meth:`_wire_side`.
+        """
+        cfg = self.config
+        mode = "auto" if cfg.exchange_stages == 0 else int(cfg.exchange_stages)
+        if cfg.exchange_codec == "off" or cfg.num_nodes == 1:
+            return ("off", mode, None, None, None)
+        key_bound = self._static_key_bound
+        if key_bound is None:
+            key_bound = self._measured_key_bound
+        if key_bound is None:
+            key_bound = self._probe_key_bound(r, s)
+        return (cfg.exchange_codec, mode, int(key_bound), r.size, s.size)
+
+    def _probe_key_bound(self, r: TupleBatch, s: TupleBatch) -> int:
+        """Exact measured key bound (device max + 1) for raw-array joins
+        that skipped the sizing pre-pass (warm starts, static sizing)."""
+        if not hasattr(self, "_maxkey_jit"):
+            self._maxkey_jit = jax.jit(
+                lambda a, b: jnp.maximum(jnp.max(a), jnp.max(b)))
+        lo = int(self._to_host(self._maxkey_jit(r.key, s.key)))
+        if r.key_hi is None:
+            return lo + 1
+        hi = int(self._to_host(self._maxkey_jit(r.key_hi, s.key_hi)))
+        return ((hi << 32) | lo) + 1
+
+    def _wire_side(self, cap: int, rid_bound):
+        """Resolve one window's codec under the current plan: ``('pack',
+        WireSpec)`` or ``('off', None)``.  codec="auto" packs only when the
+        packed block actually beats the raw lanes at this capacity — the
+        per-partition header is amortized over the block, so tiny blocks
+        can lose."""
+        cfg = self.config
+        codec = self._xplan[0]
+        if codec == "off":
+            return "off", None
+        wide = cfg.key_bits == 64
+        spec = make_wire_spec(cap, cfg.network_fanout_bits, wide=wide,
+                              key_bound=self._xplan[2], rid_bound=rid_bound)
+        if codec == "auto" and spec.bytes_per_block >= cap * (12 if wide
+                                                              else 8):
+            return "off", None
+        return "pack", spec
+
+    def _make_windows(self, cap_r: int, cap_s: int):
+        """The per-relation shuffle Windows under the resolved wire plan
+        (one construction site shared by the fused, phase-split, and
+        materializing pipelines so they cannot diverge)."""
+        cfg = self.config
+        ax, n = cfg.mesh_axes, cfg.num_nodes
+        _, mode, key_bound, rid_r, rid_s = self._xplan
+
+        def one(cap, side, rid_bound):
+            codec, _ = self._wire_side(cap, rid_bound)
+            return Window(n, cap, ax, side, codec=codec, mode=mode,
+                          fanout_bits=cfg.network_fanout_bits,
+                          key_bound=key_bound, rid_bound=rid_bound)
+
+        return one(cap_r, "inner", rid_r), one(cap_s, "outer", rid_s)
+
+    def _exchange_stats(self, cap_r: int, cap_s: int) -> dict:
+        """Static wire geometry of ONE exchange under the resolved plan —
+        everything here is shape-derived, computed on the host with no
+        device readback, and stamped into ``meta["exchange_plan"]`` so
+        bench/regress read measured-format truth instead of re-deriving it.
+
+        ``wire_bytes``: bytes each node actually ships per exchange, both
+        relations.  ``bytes_per_tuple``: wire bytes per *slot* of the block
+        format (the baseline format is exactly 8 B/slot narrow, 12 B wide —
+        per-slot keeps the A/B comparison independent of pow2 capacity
+        slack, which inflates both arms identically).
+        ``peak_exchange_bytes``: the largest single collective's live
+        buffer (simultaneously-dispatched lanes summed) — the quantity the
+        staged mode bounds to ~1/k."""
+        cfg = self.config
+        n = cfg.num_nodes
+        wide = cfg.key_bits == 64
+        raw_pt, lanes = (12, 3) if wide else (8, 2)
+        mode = self._xplan[1]
+        stats = {"codec": cfg.exchange_codec, "key_bound": self._xplan[2]}
+        wire_total = raw_total = 0
+        peak = 0
+        stages_used = 1
+        for side, cap, rid_bound in (("r", cap_r, self._xplan[3]),
+                                     ("s", cap_s, self._xplan[4])):
+            codec, spec = self._wire_side(cap, rid_bound)
+            raw = n * cap * raw_pt
+            if codec == "pack":
+                wire = n * spec.bytes_per_block
+                k = parse_exchange_mode(mode, spec.block_words)
+                side_peak = n * 4 * -(-spec.block_words // k)
+                bpt = spec.bytes_per_tuple
+            else:
+                wire = raw
+                k = parse_exchange_mode(mode, cap)
+                # the raw lane collectives have no sequencing barrier
+                # between them — count them as one in-flight buffer
+                side_peak = n * 4 * lanes * -(-cap // k)
+                bpt = float(raw_pt)
+            stats[f"codec_{side}"] = codec
+            stats[f"stages_{side}"] = k
+            stats[f"bytes_per_tuple_{side}"] = round(bpt, 4)
+            wire_total += wire
+            raw_total += raw
+            peak = max(peak, side_peak)
+            stages_used = max(stages_used, k)
+        stats["wire_bytes"] = wire_total
+        stats["raw_bytes"] = raw_total
+        stats["bytes_per_tuple"] = round(
+            wire_total / max(1, n * (cap_r + cap_s)), 4)
+        stats["pack_ratio_pct"] = round(100.0 * wire_total / max(1, raw_total),
+                                        2)
+        stats["peak_exchange_bytes"] = peak
+        stats["stages"] = stages_used
+        return stats
+
     def _strategy_label(self) -> str:
         """The executed discipline in the planner's strategy vocabulary
         (planner/cost_model.enumerate_strategies) — stamped onto timeline
@@ -1486,6 +1640,7 @@ class HashJoin:
             m.start("SWINALLOC")
         local_slack = 1
         warm = None
+        self._measured_key_bound = None   # only this join's sizing pass counts
         if self._cache_eligible():
             _, warm = self.plan_cache.lookup(r.size, s.size,
                                              self._cache_config_fp())
@@ -1500,6 +1655,15 @@ class HashJoin:
                 r, s, shuffles=not self._single_node_sort_probe())
         if m:
             m.stop("SWINALLOC")
+        # wire-format plan: resolved after sizing so the measured key bound
+        # is available; the fallback device max probe is join work and lands
+        # inside JTOTAL like every other pre-pass.  The exchange_pack span
+        # marks the host-side resolution — the packing itself is traced
+        # inside the jitted pipeline, invisible to host timers.
+        with (m.span("exchange_pack", codec=self.config.exchange_codec,
+                     stages=self.config.exchange_stages)
+              if m else contextlib.nullcontext()):
+            self._xplan = self._resolve_exchange_plan(r, s)
         self._check_cancel("sized")
         # integrity verification (robustness/verify.py): fingerprint the
         # pristine inputs before anything can damage them.  The n==1 sort
@@ -1843,10 +2007,19 @@ class HashJoin:
             if not self._single_node_sort_probe():
                 # the n==1 specialization performs no exchange at all —
                 # recording its dummy capacities would invent network stats
+                xs = self._exchange_stats(cap_r, cap_s)
+                m.meta["exchange_plan"] = xs
+                with m.span("exchange_stage", stages=xs["stages"],
+                            peak_exchange_bytes=xs["peak_exchange_bytes"]):
+                    pass   # zero-length marker: the staged collectives run
+                           # inside the jitted pipeline, untimeable from host
                 for _ in range(repeats):
                     m.record_exchange(
                         self.config.num_nodes, cap_r, cap_s,
-                        tuple_bytes=8 if r.key_hi is None else 12)
+                        tuple_bytes=8 if r.key_hi is None else 12,
+                        wire_bytes=xs["wire_bytes"],
+                        pack_ratio_pct=xs["pack_ratio_pct"],
+                        stages=xs["stages"])
             m.derive_rates()
         return JoinResult(matches=matches, ok=not flags.any(),
                           partition_counts=counts, diagnostics=diag)
@@ -1865,9 +2038,11 @@ class HashJoin:
         if m:
             m.start("JTOTAL")
             m.start("SWINALLOC")
+        self._measured_key_bound = None
         cap_r, cap_s, skew_plan = self._measure_capacities(r, s)
         if m:
             m.stop("SWINALLOC")
+        self._xplan = self._resolve_exchange_plan(r, s)
         rate_cap = self.config.match_rate_cap
         use_split = self.config.measure_phases
         for attempt in range(self.config.max_retries + 1):
@@ -1877,7 +2052,7 @@ class HashJoin:
             else:
                 key = ("mat", r.size // n, s.size // n, cap_r, cap_s,
                        rate_cap, skew_plan, r.key_hi is None,
-                       s.key_hi is None,
+                       s.key_hi is None, self._xplan,
                        getattr(r.key, "sharding", None),
                        getattr(s.key, "sharding", None))
                 fn = self._compile_timed(
@@ -1921,8 +2096,13 @@ class HashJoin:
             m.incr("RESULTS", int(valid.sum()))
             m.incr("RTUPLES", r.size)
             m.incr("STUPLES", s.size)
+            xs = self._exchange_stats(cap_r, cap_s)
+            m.meta["exchange_plan"] = xs
             m.record_exchange(n, cap_r, cap_s,
-                              tuple_bytes=8 if r.key_hi is None else 12)
+                              tuple_bytes=8 if r.key_hi is None else 12,
+                              wire_bytes=xs["wire_bytes"],
+                              pack_ratio_pct=xs["pack_ratio_pct"],
+                              stages=xs["stages"])
             m.derive_rates()
         self._stamp_fault_sites(diag)
         return MaterializedJoinResult(r_rid=r_rid, s_rid=s_rid,

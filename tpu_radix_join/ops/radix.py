@@ -131,13 +131,86 @@ def scatter_to_blocks(
     counts = bounds[1:] - bounds[:-1]
     starts = bounds[:-1]
 
+    blocks, overflow = _fill_blocks(batch, lanes, treedef, sorted_lanes,
+                                    starts, counts, num_blocks, capacity,
+                                    side, impl)
+    return blocks, counts, overflow
+
+
+def scatter_to_blocks_grouped(
+    batch,
+    dest: jnp.ndarray,
+    sub: jnp.ndarray,
+    num_blocks: int,
+    num_sub: int,
+    capacity: int,
+    side: str,
+    valid: jnp.ndarray | None = None,
+    impl: str = "loop",
+):
+    """:func:`scatter_to_blocks` with a secondary ordering key: tuples within
+    each destination block land sorted by ``sub`` (the partition id on the
+    wire-codec path), and the per-(block, sub) occupancy comes back as an
+    extra ``[num_blocks, num_sub]`` array.
+
+    That pair — pid-sorted blocks + per-pid counts — is exactly what the
+    packed exchange needs to drop the fanout bits from keys and reconstruct
+    them positionally on receipt (data/tuples.pack_blocks).  ``sub`` may be
+    ANY value in [0, num_sub) regardless of ``dest`` (skew spreading routes
+    hot tuples to destinations that don't own their partition; the header
+    records the truth).
+
+    Returns ``(blocks, counts, group_counts, overflow)`` where ``counts`` is
+    the unclipped per-destination demand (same contract as
+    ``scatter_to_blocks``) and ``group_counts`` is uint32
+    [num_blocks, num_sub], *clipped* to capacity so it sums to the tuples
+    actually present in each block."""
+    comp = dest.astype(jnp.uint32) * jnp.uint32(num_sub) + sub.astype(
+        jnp.uint32)
+    sort_key = comp
+    if valid is not None:
+        sort_key = jnp.where(valid, sort_key,
+                             jnp.uint32(num_blocks * num_sub))
+
+    lanes, treedef = jax.tree.flatten(batch)
+    sorted_all = sort_kv_unstable(sort_key, *lanes)
+    sorted_comp, sorted_lanes = sorted_all[0], sorted_all[1:]
+
+    group_bounds = jnp.searchsorted(
+        sorted_comp,
+        jnp.arange(num_blocks * num_sub + 1, dtype=jnp.uint32)
+    ).astype(jnp.uint32)
+    # destination run bounds are every num_sub-th group bound
+    bounds = group_bounds[::num_sub]
+    counts = bounds[1:] - bounds[:-1]
+    starts = bounds[:-1]
+    group_raw = (group_bounds[1:] - group_bounds[:-1]).reshape(
+        num_blocks, num_sub)
+    # clip to capacity the way the block fill does: the first ``capacity``
+    # slots of each destination run survive, i.e. the lowest pids keep their
+    # tuples and the clip eats the tail
+    cum = jnp.minimum(jnp.cumsum(group_raw, axis=1),
+                      jnp.uint32(capacity))
+    group_counts = jnp.concatenate([cum[:, :1], cum[:, 1:] - cum[:, :-1]],
+                                   axis=1)
+
+    blocks, overflow = _fill_blocks(batch, lanes, treedef, sorted_lanes,
+                                    starts, counts, num_blocks, capacity,
+                                    side, impl)
+    return blocks, counts, group_counts, overflow
+
+
+def _fill_blocks(batch, lanes, treedef, sorted_lanes, starts, counts,
+                 num_blocks, capacity, side, impl):
+    """Shared block-fill core: place each destination's sorted run into its
+    fixed-capacity block, pad the rest with the side sentinel."""
     pad_leaves = jax.tree.leaves(make_padding_like(batch, 1, side))
     col = jnp.arange(capacity, dtype=jnp.uint32)[None, :]
     col_ok = (col < jnp.minimum(counts, jnp.uint32(capacity))[:, None]
               ).reshape(-1)
 
     if impl == "gather":
-        n = sorted_dest.shape[0]
+        n = sorted_lanes[0].shape[0]
         idx = jnp.minimum((starts[:, None] + col).reshape(-1),
                           jnp.uint32(n - 1))
         masked = [
@@ -174,4 +247,4 @@ def scatter_to_blocks(
     blocks = jax.tree.unflatten(treedef, masked)
     overflow = jnp.sum(
         jnp.maximum(counts, jnp.uint32(capacity)) - jnp.uint32(capacity))
-    return blocks, counts, overflow.astype(jnp.uint32)
+    return blocks, overflow.astype(jnp.uint32)

@@ -39,7 +39,7 @@ def _mix32(x: jnp.ndarray) -> jnp.ndarray:
 
 
 def distribute(batch: TupleBatch, num_nodes: int, axis_name: str,
-               seed: int = 0) -> TupleBatch:
+               seed: int = 0, mode="fused") -> TupleBatch:
     """Redistribute so every node holds a uniform slice of the global data.
 
     Runs inside ``shard_map`` over ``axis_name``.  The local shard is cut into
@@ -47,6 +47,12 @@ def distribute(batch: TupleBatch, num_nodes: int, axis_name: str,
     (``all_to_all``), then the received tuples are locally shuffled by a
     seeded hash — together the exact effect of the reference's section
     exchange + ``shuffle`` (``Relation.cpp:99-141``).
+
+    ``mode`` is the staged-exchange knob ("fused" | "staged:<k>" | "auto",
+    parallel/window.block_all_to_all): redistribution moves the entire
+    relation at once, so it benefits first from bounding live exchange
+    memory to ~1/k.  (The bit-pack codec does not apply here — there is no
+    partition structure yet to imply key bits from.)
 
     The local size must divide by ``num_nodes`` (the reference has the same
     constraint implicitly: equal section sizes, ``Relation.cpp:106``).
@@ -58,7 +64,7 @@ def distribute(batch: TupleBatch, num_nodes: int, axis_name: str,
 
     received = TupleBatch(*(
         None if lane is None else block_all_to_all(lane, num_nodes, block,
-                                                   axis_name)
+                                                   axis_name, mode=mode)
         for lane in batch))
 
     me = jax.lax.axis_index(axis_name).astype(jnp.uint32)

@@ -59,7 +59,10 @@ def network_partition(
         dest = jnp.where(override[0], override[1], dest)
     if exclude is not None:
         valid = ~exclude if valid is None else (valid & ~exclude)
-    res: ExchangeResult = window.exchange(batch, dest, valid=valid)
+    # pid rides along for the packed wire codec: the bit-packed format drops
+    # the fanout bits and the receiver restores them from the header's
+    # per-partition counts (a no-op for codec="off" windows)
+    res: ExchangeResult = window.exchange(batch, dest, valid=valid, pid=pid)
     recv_valid = valid_mask(res.batch, window.side)
     recv_pid = partition_ids(res.batch, fanout_bits)
     return NetworkPartitionResult(

@@ -13,27 +13,115 @@ ICI mesh axis delivers block j of every sender to node j.  Padding slots carry
 side sentinels; per-sender valid counts ride along in a second (tiny)
 all_to_all — the moral equivalent of OffsetMap's exactly-written guarantee.
 Epochs/barriers are implicit in XLA program order.
+
+Two orthogonal levers reshape the wire (ISSUE 7):
+
+* ``mode="staged:<k>"`` slices the [N, C] block buffer into k column groups
+  exchanged by a *sequence* of smaller collectives chained with
+  ``optimization_barrier`` — live exchange memory drops to ~1/k of the fused
+  peak (the portable-redistribution decomposition of arXiv 2112.01075) while
+  the received ordering stays bit-identical to the fused route.
+* ``codec="pack"`` bit-packs tuples to their measured key/rid bounds before
+  the collective (data/tuples.pack_blocks) and unpacks exactly on receipt;
+  the packed block's header region carries the per-partition valid counts,
+  so the separate count collective disappears.
 """
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from tpu_radix_join.ops.radix import scatter_to_blocks
+from tpu_radix_join.data.tuples import (WireSpec, make_wire_spec, pack_blocks,
+                                        unpack_blocks)
+from tpu_radix_join.ops.radix import (scatter_to_blocks,
+                                      scatter_to_blocks_grouped)
 from tpu_radix_join.parallel.mesh import AxisName
 
 
+def parse_exchange_mode(mode, block: int) -> int:
+    """Resolve an exchange mode to a stage count k >= 1.
+
+    ``"fused"``/1 = one collective; ``"staged:<k>"``/k = k column-group
+    collectives; ``"auto"`` stages 4-ways once the block is large enough
+    that the ~1/k live-memory bound matters (>= 4096 slots per block —
+    below that the whole buffer is smaller than the staging bookkeeping
+    is worth)."""
+    if isinstance(mode, int):
+        k = mode
+    elif mode == "fused":
+        k = 1
+    elif mode == "auto":
+        k = 4 if block >= 4096 else 1
+    elif isinstance(mode, str) and mode.startswith("staged:"):
+        try:
+            k = int(mode.split(":", 1)[1])
+        except ValueError:
+            raise ValueError(
+                f"exchange mode {mode!r}: the stage count after 'staged:' "
+                f"must be an integer") from None
+    else:
+        raise ValueError(
+            f"exchange mode must be 'fused', 'staged:<k>', 'auto', or an "
+            f"int stage count, got {mode!r}")
+    if k < 1:
+        raise ValueError(f"exchange stage count must be >= 1, got {k}")
+    return min(k, block) if block else k
+
+
 def block_all_to_all(x: jnp.ndarray, num_nodes: int, block: int,
-                     axis_name: AxisName) -> jnp.ndarray:
+                     axis_name: AxisName, mode="fused") -> jnp.ndarray:
     """Dense block exchange: slice ``x``'s leading [num_nodes * block] axis
     into per-destination blocks and deliver block j to node j.  The single
     collective that replaces the reference's windowed ``MPI_Put`` schedule
     (Window.cpp:86-144) and pairwise ``MPI_Send/Recv`` exchange
     (Relation.cpp:104-136).  Runs inside shard_map over ``axis_name``; a
-    ``(dcn, ici)`` axis pair selects the hierarchical route."""
+    ``(dcn, ici)`` axis pair selects the hierarchical route.
+
+    ``mode`` ("fused" | "staged:<k>" | "auto" | int) splits the block
+    dimension into k column groups exchanged sequentially (chained with
+    ``optimization_barrier`` so XLA cannot re-fuse them): peak live exchange
+    memory drops to ~1/k while the received ordering stays identical to the
+    fused route — group i of sender s lands in the same rows either way,
+    and concatenating the groups along the block axis restores the exact
+    fused layout."""
+    if x.shape[0] != num_nodes * block:
+        raise ValueError(
+            f"block_all_to_all: leading axis of {x.shape[0]} must equal "
+            f"num_nodes * block = {num_nodes} * {block} = "
+            f"{num_nodes * block} (one fixed-capacity block per "
+            f"destination)")
+    stages = parse_exchange_mode(mode, block)
+    if stages == 1:
+        return _one_exchange(x, num_nodes, block, axis_name)
+    rest = x.shape[1:]
+    v = x.reshape((num_nodes, block) + rest)
+    base, extra = divmod(block, stages)
+    sizes = [base + (1 if i < extra else 0) for i in range(stages)]
+    outs = []
+    prev = None
+    off = 0
+    for g in sizes:
+        part = v[:, off:off + g]
+        if prev is not None:
+            # tie group i+1's send to group i's arrival: the collectives
+            # run as a sequence, so only ~1/k of the buffer is in flight
+            part, _ = jax.lax.optimization_barrier((part, prev))
+        out = _one_exchange(
+            part.reshape((num_nodes * g,) + rest), num_nodes, g, axis_name
+        ).reshape((num_nodes, g) + rest)
+        outs.append(out)
+        prev = out
+        off += g
+    return jnp.concatenate(outs, axis=1).reshape(
+        (num_nodes * block,) + rest)
+
+
+def _one_exchange(x: jnp.ndarray, num_nodes: int, block: int,
+                  axis_name: AxisName) -> jnp.ndarray:
+    """One fused block exchange (flat or hierarchical by axis type)."""
     if not isinstance(axis_name, str):
         dcn_axis, ici_axis = axis_name
         return hierarchical_block_all_to_all(x, num_nodes, block,
@@ -63,7 +151,12 @@ def hierarchical_block_all_to_all(x: jnp.ndarray, num_nodes: int, block: int,
     """
     num_hosts = jax.lax.axis_size(dcn_axis)
     per_host = jax.lax.axis_size(ici_axis)
-    assert num_hosts * per_host == num_nodes
+    if num_hosts * per_host != num_nodes:
+        raise ValueError(
+            f"hierarchical exchange: mesh axes ({dcn_axis!r}={num_hosts}) x "
+            f"({ici_axis!r}={per_host}) = {num_hosts * per_host} devices, "
+            f"but num_nodes={num_nodes} — the (dcn, ici) mesh must factor "
+            f"the node count exactly")
     v = x.reshape((num_hosts, per_host, block) + x.shape[1:])
     # Stage 1 (ICI): deliver column l of every destination host to local peer l.
     v = jax.lax.all_to_all(v, ici_axis, split_axis=1, concat_axis=1,
@@ -87,29 +180,73 @@ class Window:
     analog of ``computeWindowSize`` (Window.cpp:168-177) except sized ahead of
     the data with ``allocation_factor`` slack (overflow is reported, never
     silently dropped from the accounting).
+
+    ``codec="pack"`` + a :class:`~tpu_radix_join.data.tuples.WireSpec`
+    switches the wire to the bounds-aware bit-packed format: tuples travel at
+    ``spec.tuple_bits`` bits each and the packed header replaces the count
+    side channel (one collective per exchange instead of lanes + counts).
+    ``mode`` is the staged-exchange knob forwarded to every collective this
+    window dispatches.
     """
 
     def __init__(self, num_nodes: int, capacity: int, axis_name: AxisName,
-                 side: str):
+                 side: str, codec: str = "off", mode="fused",
+                 fanout_bits: int = 0,
+                 key_bound: Optional[int] = None,
+                 rid_bound: Optional[int] = None):
+        if codec not in ("off", "pack"):
+            raise ValueError(
+                f"window codec must be 'off' or 'pack', got {codec!r} "
+                f"('auto' must be resolved by the caller)")
         self.num_nodes = num_nodes
         self.capacity = capacity
         self.axis_name = axis_name
         self.side = side
+        self.codec = codec
+        self.mode = mode
+        self.fanout_bits = fanout_bits
+        self.key_bound = key_bound
+        self.rid_bound = rid_bound
+
+    def wire_spec(self, wide: bool) -> WireSpec:
+        """The packed-wire geometry for this window's bounds (static)."""
+        return make_wire_spec(self.capacity, self.fanout_bits, wide=wide,
+                              key_bound=self.key_bound,
+                              rid_bound=self.rid_bound)
 
     def exchange(self, batch, dest: jnp.ndarray,
-                 valid: jnp.ndarray | None = None) -> ExchangeResult:
+                 valid: jnp.ndarray | None = None,
+                 pid: jnp.ndarray | None = None) -> ExchangeResult:
         """Scatter into destination blocks and all_to_all them.
 
         ``batch``: TupleBatch/CompressedBatch with [n] lanes; ``dest``: uint32
         [n] destination node per tuple (= assignment[pid], Window.cpp:110).
+        ``pid``: the tuple partition ids — required by the packed codec
+        (the dropped key bits are reconstructed from partition membership).
         Runs inside shard_map over ``axis_name``.
         """
         n, c = self.num_nodes, self.capacity
+        if self.codec == "pack":
+            if pid is None:
+                raise ValueError(
+                    "codec='pack' needs the per-tuple partition ids: the "
+                    "wire drops the fanout bits and restores them from "
+                    "partition membership — pass pid= to exchange()")
+            spec = self.wire_spec(wide=batch[2] is not None)
+            blocks, counts, group_counts, overflow = scatter_to_blocks_grouped(
+                batch, dest, pid, n, spec.num_sub, c, self.side, valid=valid)
+            words = pack_blocks(spec, blocks, group_counts)
+            recv_words = block_all_to_all(words, n, spec.block_words,
+                                          self.axis_name, mode=self.mode)
+            recv_batch, recv_counts = unpack_blocks(spec, recv_words,
+                                                    self.side)
+            return ExchangeResult(recv_batch, recv_counts, overflow)
         blocks, counts, overflow = scatter_to_blocks(
             batch, dest, n, c, self.side, valid=valid)
 
         received = jax.tree.map(
-            lambda x: block_all_to_all(x, n, c, self.axis_name), blocks)
+            lambda x: block_all_to_all(x, n, c, self.axis_name,
+                                       mode=self.mode), blocks)
         sent_counts = jnp.minimum(counts, jnp.uint32(c))
         recv_counts = block_all_to_all(sent_counts, n, 1, self.axis_name)
         return ExchangeResult(received, recv_counts, overflow)

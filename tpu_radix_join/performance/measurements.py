@@ -58,6 +58,12 @@ STUPLES = "STUPLES"        # outer tuples joined (counter)
 RESULTS = "RESULTS"        # global match count (RESULT_COUNTER analog)
 MWINPUTCNT = "MWINPUTCNT"  # logical block transfers shuffled (MPI_Put count analog)
 MWINBYTES = "MWINBYTES"    # shuffle wire bytes incl. padding (8B/tuple slots)
+WIREBYTES = "WIREBYTES"    # actual wire bytes shipped per exchange under the
+                           # active codec (== MWINBYTES when codec="off";
+                           # smaller under the bit-packed format)
+PACKRATIO = "PACKRATIO"    # gauge: packed wire bytes as a percent of the raw
+                           # two/three-lane format (100 = no compression)
+XSTAGES = "XSTAGES"        # gauge: column groups per staged exchange (1 = fused)
 WINCAPR = "WINCAPR"        # per-(sender,dest) block capacity, inner window
 WINCAPS = "WINCAPS"        # per-(sender,dest) block capacity, outer window
 FINJECT = "FINJECT"        # injected faults fired (robustness/faults.py)
@@ -213,16 +219,31 @@ class Measurements:
 
     # ----------------------------------------------------- detail accumulators
     def record_exchange(self, num_nodes: int, cap_r: int, cap_s: int,
-                        tuple_bytes: int = 8) -> None:
+                        tuple_bytes: int = 8,
+                        wire_bytes: Optional[int] = None,
+                        pack_ratio_pct: Optional[float] = None,
+                        stages: Optional[int] = None) -> None:
         """Shuffle-detail counters (MEASUREMENT_DETAILS_NETWORK analog,
         Measurements.cpp:272-349): the reference counts every 64KB ``MPI_Put``
         and its bytes in the hot loop; here block geometry is static so the
         equivalent quantities are derived — per relation, each node ships N
         blocks of ``capacity`` wire tuples (window.block_all_to_all).
         ``tuple_bytes``: 8 for two uint32 lanes (the reference's
-        CompressedTuple size), 12 when the key_hi lane travels too."""
+        CompressedTuple size), 12 when the key_hi lane travels too.
+
+        ``wire_bytes``: actual bytes shipped per node per exchange under the
+        active codec (packed block words x 4; defaults to the raw lane
+        bytes when the codec is off).  ``pack_ratio_pct`` and ``stages`` are
+        gauges describing the exchange plan (100 / 1 = codec off, fused)."""
         self.incr(MWINPUTCNT, 2 * num_nodes)
-        self.incr(MWINBYTES, tuple_bytes * num_nodes * (cap_r + cap_s))
+        raw_bytes = tuple_bytes * num_nodes * (cap_r + cap_s)
+        self.incr(MWINBYTES, raw_bytes)
+        self.incr(WIREBYTES,
+                  raw_bytes if wire_bytes is None else int(wire_bytes))
+        if pack_ratio_pct is not None:
+            self.counters[PACKRATIO] = int(round(pack_ratio_pct))
+        if stages is not None:
+            self.counters[XSTAGES] = int(stages)
         self.counters[WINCAPR] = cap_r
         self.counters[WINCAPS] = cap_s
 

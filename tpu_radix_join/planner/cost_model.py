@@ -25,6 +25,7 @@ import dataclasses
 import math
 from typing import Dict, Optional, Tuple
 
+from tpu_radix_join.data.tuples import make_wire_spec
 from tpu_radix_join.ops.merge_count import MAX_MERGE_KEY
 from tpu_radix_join.planner.profile import (DeviceProfile, SORT_REF_ELEMS,
                                             sort_stage_units)
@@ -122,15 +123,24 @@ def hbm_pass_ms(profile: DeviceProfile, byts: float) -> float:
     return 2.0 * byts / profile.value("hbm_gbps") / 1e9 * 1e3
 
 
-def shuffle_ms(profile: DeviceProfile, w: Workload) -> float:
+def shuffle_ms(profile: DeviceProfile, w: Workload,
+               bytes_per_tuple: Optional[float] = None) -> float:
     """all_to_all wire time per chip: each relation ships its non-local
-    share (``local * (N-1)/N``) over ICI (PERF_NOTES mesh-scaling model)."""
+    share (``local * (N-1)/N``) over ICI (PERF_NOTES mesh-scaling model).
+
+    ``bytes_per_tuple`` is the wire footprint per tuple slot under the
+    active exchange codec — by default the raw lane width (8 B narrow /
+    12 B wide), or a :func:`~tpu_radix_join.data.tuples.make_wire_spec`
+    estimate when the bit-packed codec is being priced (plan_exchange).
+    """
     n = w.num_nodes
     if n <= 1:
         return 0.0
+    if bytes_per_tuple is None:
+        bytes_per_tuple = w.lanes * LANE_BYTES
     local = (w.r_tuples + w.s_tuples) / n
-    wire_bytes = w.lanes * LANE_BYTES * local * (n - 1) / n
-    return wire_bytes / profile.value("ici_gbps") / 1e9 * 1e3
+    wire_bytes = bytes_per_tuple * local * (n - 1) / n
+    return wire_bytes / profile.value("ici_bytes_per_s") * 1e3
 
 
 def dispatch_ms(profile: DeviceProfile, programs: int) -> float:
@@ -141,6 +151,92 @@ def scatter_loop_ms(profile: DeviceProfile, elems: int) -> float:
     """The block-scatter loop discipline's permutation cost (the second
     radix pass's destination grouping)."""
     return elems / profile.value("scatter_loop_melems_s") / 1e6 * 1e3
+
+
+def network_fanout_bits(w: Workload) -> int:
+    """Network radix bits: at least enough partitions to cover the mesh,
+    at most the default 32-way fanout, and never more partitions than
+    tuples per node (tiny relations would leave most partitions empty and
+    pay histogram width for nothing)."""
+    floor_bits = max(0, math.ceil(math.log2(max(1, w.num_nodes))))
+    per_node = max(1, w.r_tuples // max(1, w.num_nodes))
+    size_cap = max(1, per_node.bit_length() - 3)
+    return max(floor_bits, min(5, size_cap))
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangePlan:
+    """The cost model's exchange-layer decision: which wire codec and how
+    many staged column groups, with both arms' prices kept for the explain
+    table (``wire_off_ms`` is what the raw 8/12 B lanes would have cost)."""
+
+    codec: str              # "off" | "pack"
+    stages: int             # 1 = fused all_to_all, k > 1 = staged groups
+    bytes_per_tuple: float  # wire footprint per slot under the chosen codec
+    wire_ms: float          # shuffle wire time under the chosen codec
+    pack_ms: float          # codec compute (pack + unpack passes); 0 if off
+    wire_off_ms: float      # the raw-lane arm, for comparison
+    note: str = ""
+
+
+def plan_exchange(profile: DeviceProfile, w: Workload,
+                  fanout_bits: Optional[int] = None) -> ExchangePlan:
+    """Price both exchange arms and pick the cheaper.
+
+    The packed arm's bytes/tuple comes from the same ``WireSpec`` geometry
+    the engine ships (data/tuples.make_wire_spec) — key bits implied by the
+    workload's static key bound minus the network fanout bits, rid bits by
+    the relation sizes — so the planner and the wire agree on the payload
+    width.  Pack compute is two extra streaming passes over the packed
+    words (sender pack, receiver unpack), priced at the HBM envelope;
+    packing wins exactly when the ICI bytes saved outrun that.
+
+    Packing also wins on *memory*: within half the residency budget of the
+    envelope, the smaller live exchange footprint buys headroom the ms
+    model cannot see (exchange buffers are part of the in-core working
+    set), so under pressure the packed arm is chosen whenever it actually
+    shrinks the wire — the decisive shape knob alongside the byte ratio.
+
+    Stages mirror the engine's ``exchange_stages=0`` auto rule: blocks big
+    enough to matter (>= 4096 slots) exchange in 4 column groups, bounding
+    live exchange memory to ~1/4 at no modeled wire cost (the groups ride
+    the same link back to back).
+    """
+    n = w.num_nodes
+    raw_bpt = w.lanes * LANE_BYTES
+    if n <= 1:
+        return ExchangePlan(codec="off", stages=1, bytes_per_tuple=raw_bpt,
+                            wire_ms=0.0, pack_ms=0.0, wire_off_ms=0.0,
+                            note="single node: no exchange")
+    if fanout_bits is None:
+        fanout_bits = network_fanout_bits(w)
+    # per-(sender, destination) block capacity estimate — uniform split of
+    # the per-node share; only the header amortization depends on it
+    cap_est = max(1, w.union_per_node // n)
+    spec = make_wire_spec(cap_est, fanout_bits, wide=(w.key_bits == 64),
+                          key_bound=w.key_bound,
+                          rid_bound=max(w.r_tuples, w.s_tuples))
+    wire_off = shuffle_ms(profile, w)
+    wire_pack = shuffle_ms(profile, w, spec.bytes_per_tuple)
+    local = (w.r_tuples + w.s_tuples) / n
+    pack_cost = 2.0 * hbm_pass_ms(profile, spec.bytes_per_tuple * local)
+    stages = 4 if cap_est >= 4096 else 1
+    cheaper = wire_pack + pack_cost < wire_off
+    pressured = (spec.bytes_per_tuple < raw_bpt
+                 and incore_resident_bytes(w) > 0.5 * w.budget(profile))
+    if cheaper or pressured:
+        why = (f"pack {spec.bytes_per_tuple:.2f} B/tuple vs {raw_bpt} B raw"
+               + ("" if cheaper else
+                  "; chosen for memory headroom near the residency budget"))
+        return ExchangePlan(
+            codec="pack", stages=stages,
+            bytes_per_tuple=spec.bytes_per_tuple, wire_ms=wire_pack,
+            pack_ms=pack_cost, wire_off_ms=wire_off, note=why)
+    return ExchangePlan(
+        codec="off", stages=stages, bytes_per_tuple=raw_bpt,
+        wire_ms=wire_off, pack_ms=0.0, wire_off_ms=wire_off,
+        note=(f"raw {raw_bpt} B/tuple; pack would cost "
+              f"{wire_pack + pack_cost:.2f} ms vs {wire_off:.2f} ms wire"))
 
 
 def wide_sort_factor(profile: DeviceProfile) -> float:
@@ -193,7 +289,13 @@ def enumerate_strategies(profile: DeviceProfile,
     mem_note = ("" if fits else
                 f"resident ~{incore_resident_bytes(w) / 1e9:.1f} GB exceeds "
                 f"the {w.budget(profile) / 1e9:.1f} GB budget")
-    shuf = shuffle_ms(profile, w)
+    # codec-aware exchange: the shuffle term consumes the chosen arm's
+    # actual wire bytes/tuple (plan_exchange), not a hardcoded lane width;
+    # the packed arm's codec compute shows up as its own "pack" column
+    xplan = plan_exchange(profile, w)
+    shuf = xplan.wire_ms
+    xch = ({"shuffle": shuf, "pack": xplan.pack_ms}
+           if xplan.pack_ms > 0 else {"shuffle": shuf})
     scan = hbm_pass_ms(profile, union_bytes)
 
     def amortized_dispatch(programs: int, pipelinable: bool = True) -> float:
@@ -223,11 +325,11 @@ def enumerate_strategies(profile: DeviceProfile,
             continue
         sort = sort_ms(profile, union, lane_factor)
         add(f"incore_fused_sort_{key_mode}", key_ok and fits,
-            {"sort": sort, "scan": scan, "shuffle": shuf,
+            {"sort": sort, "scan": scan, **xch,
              "dispatch": amortized_dispatch(PROGRAMS["fused"])},
             note=key_why or mem_note)
         add(f"incore_split_sort_{key_mode}", key_ok and fits,
-            {"sort": sort, "scan": scan, "shuffle": shuf,
+            {"sort": sort, "scan": scan, **xch,
              "dispatch": amortized_dispatch(PROGRAMS["split_sort"],
                                             pipelinable=False)},
             note=(key_why or mem_note
@@ -241,7 +343,7 @@ def enumerate_strategies(profile: DeviceProfile,
         "scatter": scatter_loop_ms(profile, union),
         "sort": sort_ms(profile, union, 1.0, rows=nb),
         "scan": scan,
-        "shuffle": shuf,
+        **xch,
         "dispatch": amortized_dispatch(PROGRAMS["fused"]),
     }
     add("incore_fused_twolevel", fits, twolevel,

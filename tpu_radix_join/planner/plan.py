@@ -11,18 +11,21 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import math
 import os
 from typing import List, Optional, Tuple
 
 from tpu_radix_join.planner.cost_model import (StrategyCost, Workload,
                                                enumerate_strategies,
-                                               pick_chunk_tuples)
+                                               network_fanout_bits,
+                                               pick_chunk_tuples,
+                                               plan_exchange)
 from tpu_radix_join.planner.profile import DeviceProfile
 
 # v2 adds ``grid_pipeline`` (the chunked engine's pipelined/synchronous
-# knob); v1 files load with its default ("auto").
-PLAN_SCHEMA_VERSION = 2
+# knob); v3 adds ``exchange_codec``/``exchange_stages`` (the bit-packed
+# wire codec and staged all_to_all).  Older files load with the fields'
+# defaults ("auto" pipeline, "off" codec, fused exchange).
+PLAN_SCHEMA_VERSION = 3
 
 
 class PlanError(ValueError):
@@ -48,6 +51,8 @@ class JoinPlan:
     local_fanout_bits: int = 5
     chunk_tuples: Optional[int] = None   # chunked engine only
     grid_pipeline: str = "auto"          # chunked engine: "off"|"on"|"auto"
+    exchange_codec: str = "off"          # wire codec: "off" | "pack"
+    exchange_stages: int = 1             # 1 = fused all_to_all, k>1 staged
     pipeline_repeats: bool = False
     strategy: str = ""
     predicted_ms: float = 0.0
@@ -99,18 +104,15 @@ class JoinPlan:
             "network_fanout_bits": self.network_fanout_bits,
             "local_fanout_bits": self.local_fanout_bits,
             "measure_phases": not self.fused,
+            "exchange_codec": self.exchange_codec,
+            "exchange_stages": self.exchange_stages,
         }
 
 
-def _fanout_bits(w: Workload) -> int:
-    """Network radix bits: at least enough partitions to cover the mesh,
-    at most the default 32-way fanout, and never more partitions than
-    tuples per node (tiny relations would leave most partitions empty and
-    pay histogram width for nothing)."""
-    floor_bits = max(0, math.ceil(math.log2(max(1, w.num_nodes))))
-    per_node = max(1, w.r_tuples // max(1, w.num_nodes))
-    size_cap = max(1, per_node.bit_length() - 3)
-    return max(floor_bits, min(5, size_cap))
+# network radix bits now live in cost_model.network_fanout_bits so the
+# exchange pricing (plan_exchange) derives the wire geometry from the same
+# fanout the plan binds
+_fanout_bits = network_fanout_bits
 
 
 def plan_join(profile: DeviceProfile, workload: Workload
@@ -126,11 +128,16 @@ def plan_join(profile: DeviceProfile, workload: Workload
             "infeasible:\n" + explain_table(costs))
     best = min(feasible, key=lambda c: c.cost_ms)
     bits = _fanout_bits(workload)
+    xplan = plan_exchange(profile, workload, fanout_bits=bits)
     kw = dict(network_fanout_bits=bits,
+              exchange_codec=xplan.codec,
+              exchange_stages=xplan.stages,
               pipeline_repeats=workload.repeats > 1,
               strategy=best.strategy, predicted_ms=best.cost_ms,
               profile_name=profile.name)
     if best.strategy in ("chunked_grid", "chunked_grid_pipelined"):
+        # the single-node grid engine never exchanges — keep the plan's
+        # codec fields at their inert defaults
         plan = JoinPlan(engine="chunked",
                         chunk_tuples=pick_chunk_tuples(profile, workload),
                         grid_pipeline=("on" if best.strategy.endswith(
@@ -139,7 +146,8 @@ def plan_join(profile: DeviceProfile, workload: Workload
                         else ("full" if not _narrow(workload) else "narrow"),
                         pipeline_repeats=False,
                         **{k: v for k, v in kw.items()
-                           if k != "pipeline_repeats"})
+                           if k not in ("pipeline_repeats", "exchange_codec",
+                                        "exchange_stages")})
     elif best.strategy == "incore_fused_twolevel":
         plan = JoinPlan(engine="incore", probe="bucket", two_level=True,
                         key_range="auto", **kw)
@@ -196,4 +204,10 @@ def explain_table(costs: List[StrategyCost],
         lines.append(f"chosen: {chosen.strategy} "
                      f"(predicted {chosen.predicted_ms:.1f} ms/join, "
                      f"profile {chosen.profile_name})")
+        if chosen.engine == "incore":
+            lines.append(
+                f"exchange: codec={chosen.exchange_codec} "
+                f"stages={chosen.exchange_stages} "
+                f"({'fused' if chosen.exchange_stages <= 1 else 'staged'} "
+                f"all_to_all)")
     return "\n".join(lines)

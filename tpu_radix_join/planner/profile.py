@@ -28,7 +28,11 @@ import os
 import time
 from typing import Dict, Optional
 
-SCHEMA_VERSION = 1
+# v2 adds ``ici_bytes_per_s`` — the exchange constant the codec-aware
+# wire-time term consumes (cost_model.plan_exchange).  v1 profiles load
+# through a shim that derives it from the cited ``ici_gbps`` (see
+# load_profile), so old files keep working without edits.
+SCHEMA_VERSION = 2
 
 #: Constants the cost model reads.  Adding a term to cost_model.py means
 #: adding its constant here AND to every shipped profile, with a source tag
@@ -54,6 +58,11 @@ REQUIRED_CONSTANTS = (
     "gather_melems_s",
     # per-chip interconnect bandwidth the all_to_all shuffle rides
     "ici_gbps",
+    # the same link expressed in bytes/s — the unit the codec-aware wire
+    # time consumes (wire_ms = wire_bytes / ici_bytes_per_s * 1e3, with
+    # wire_bytes taken from the packed WireSpec, not a hardcoded 8 B/tuple).
+    # Schema v2; v1 profiles are shimmed to ici_gbps * 1e9 at load.
+    "ici_bytes_per_s",
 )
 
 #: Reference element count of the sort stage model's unit (PERF_NOTES
@@ -155,9 +164,23 @@ def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
     except (OSError, json.JSONDecodeError) as e:
         raise ProfileError(f"unreadable profile {path}: {e!r}") from e
     try:
+        constants = dict(doc["constants"])
+        version = int(doc.get("schema_version", 1))
+        if version < 2 and "ici_bytes_per_s" not in constants:
+            # schema-v1 shim: the codec-aware wire time (schema v2) reads
+            # ici_bytes_per_s; derive it from the v1 profile's cited
+            # ici_gbps so old files load unchanged.  The source tag records
+            # the derivation, keeping the citation chain auditable.
+            entry = constants.get("ici_gbps")
+            if isinstance(entry, dict) and "value" in entry:
+                constants["ici_bytes_per_s"] = {
+                    "value": float(entry["value"]) * 1e9,
+                    "source": ("shim:derived from ici_gbps "
+                               "(schema v1 profile; "
+                               f"{entry.get('source', 'uncited')})")}
         return DeviceProfile(
-            name=doc["name"], constants=doc["constants"],
-            schema_version=int(doc.get("schema_version", 1)),
+            name=doc["name"], constants=constants,
+            schema_version=version,
             notes=doc.get("notes", ""))
     except KeyError as e:
         raise ProfileError(f"profile {path} missing field {e}") from e
