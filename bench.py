@@ -70,7 +70,7 @@ def _planned_strategy(size, iters):
         return {"strategy": "unknown", "error": repr(e)}
 
 
-def _wait_for_backend(planned=None):
+def _wait_for_backend(planned=None, forensics_dir=None):
     """Probe the device backend, retrying a downed tunnel for up to
     BENCH_TUNNEL_WAIT_SEC (default 20 min) before giving up.
 
@@ -141,6 +141,23 @@ def _wait_for_backend(planned=None):
             "wait_budget_s": budget,
             "last_error": str(e.last_error),
         }))
+        if forensics_dir:
+            # the death the bundles were invented for: rounds 3-5 left only
+            # a bare rc=2 behind when the tunnel died under the bench
+            try:
+                from tpu_radix_join.observability.postmortem import \
+                    write_bundle
+                path = write_bundle(
+                    forensics_dir, None, reason="backend_unavailable",
+                    failure_class=BACKEND_UNAVAILABLE,
+                    extra={"probe_attempts": e.attempts,
+                           "wait_budget_s": budget,
+                           "last_error": str(e.last_error),
+                           "planned": planned})
+                print(f"note: forensics bundle {path}", file=sys.stderr)
+            except Exception as be:    # noqa: BLE001 — forensics must not
+                print(f"note: bundle write failed: {be!r}",   # mask
+                      file=sys.stderr)
         sys.exit(2)
 
 
@@ -187,11 +204,12 @@ def _sort_bandwidth_gbps(probe_dt_s, size):
     return min_traffic_bytes / sort_s / 1e9, src
 
 
-def _run_chaos(runs, base_seed=0):
+def _run_chaos(runs, base_seed=0, forensics_dir=None):
     """``--chaos N``: CPU soak of N seeded fault schedules with verification
     on.  Prints one outcome line per run and a JSON summary; a violating
-    schedule is shrunk to a minimal repro written under artifacts/chaos/.
-    Exit 0 iff no violations."""
+    schedule is shrunk to a minimal repro written under artifacts/chaos/,
+    with a forensics bundle (observability/postmortem.py) named in the
+    repro.  Exit 0 iff no violations."""
     from tpu_radix_join.utils.platform import force_host_cpu_devices
     force_host_cpu_devices(8, respect_existing=True)
     from tpu_radix_join.robustness import chaos
@@ -201,7 +219,10 @@ def _run_chaos(runs, base_seed=0):
         print(f"[CHAOS] seed={out.schedule.seed} {out.status}{cls} "
               f"arms={[s for s, _ in out.schedule.arms]}")
 
-    runner = chaos.ChaosRunner(verify="check")
+    here = os.path.dirname(os.path.abspath(__file__))
+    bundle_dir = forensics_dir or os.path.join(here, "artifacts", "chaos",
+                                               "forensics")
+    runner = chaos.ChaosRunner(verify="check", bundle_dir=bundle_dir)
     outcomes, summary = chaos.soak(runs, base_seed=base_seed, runner=runner,
                                    on_outcome=show)
     for out in outcomes:
@@ -217,6 +238,8 @@ def _run_chaos(runs, base_seed=0):
         path = os.path.join(rdir, f"repro_seed{shrunk.seed}.json")
         print("[CHAOS] repro " + chaos.write_repro(repro, path))
         print(f"[CHAOS] repro written to {path}")
+        if repro.bundle:
+            print(f"[CHAOS] forensics bundle {repro.bundle}")
     print("[CHAOS] " + json.dumps(summary, sort_keys=True))
     return 0 if summary["violations"] == 0 else 1
 
@@ -496,6 +519,16 @@ def main():
     # flag fails fast instead of after a multi-minute timed run
     check_baseline = None
     argv = sys.argv[1:]
+    # forensics bundles (observability/postmortem.py): every bench death
+    # path — chaos violations, backend-probe exhaustion — drops one here
+    forensics_dir = os.environ.get("TPU_RADIX_FORENSICS_DIR")
+    if "--forensics-dir" in argv:
+        i = argv.index("--forensics-dir")
+        if i + 1 >= len(argv):
+            print("error: --forensics-dir needs a directory path",
+                  file=sys.stderr)
+            sys.exit(2)
+        forensics_dir = argv[i + 1]
     if "--chaos" in argv:
         # chaos soak mode (robustness/chaos.py): N seeded fault schedules
         # with verification always on, every run must pass or classify;
@@ -511,7 +544,8 @@ def main():
             sys.exit(2)
         base_seed = (int(argv[argv.index("--chaos-seed") + 1])
                      if "--chaos-seed" in argv else 0)
-        sys.exit(_run_chaos(runs, base_seed=base_seed))
+        sys.exit(_run_chaos(runs, base_seed=base_seed,
+                            forensics_dir=forensics_dir))
     if "--check-regress" in argv:
         i = argv.index("--check-regress")
         if i + 1 >= len(argv):
@@ -549,7 +583,7 @@ def main():
 
     size = 1 << 24               # 16M tuples per side
     planned = _planned_strategy(size, iters=20)
-    _wait_for_backend(planned)
+    _wait_for_backend(planned, forensics_dir=forensics_dir)
     # Cooperative chip reservation: long-running grid experiments
     # (chunked_join_grid) park between chunk pairs while this PID-stamped
     # file exists, so a background out-of-core run on the shared single

@@ -311,14 +311,21 @@ def test_planner_prices_codec_and_explains_choice():
     assert cfg.exchange_codec == "pack"
 
 
-def test_plan_schema_v3_and_v2_back_compat():
+def test_plan_schema_v4_and_older_back_compat():
     from tpu_radix_join.planner.plan import PLAN_SCHEMA_VERSION, JoinPlan
-    assert PLAN_SCHEMA_VERSION == 3
+    assert PLAN_SCHEMA_VERSION == 4
     doc = JoinPlan(engine="incore", exchange_codec="pack",
-                   exchange_stages=4).to_dict()
+                   exchange_stages=4,
+                   predicted_terms={"shuffle": 1.5}).to_dict()
     again = JoinPlan.from_dict(doc)
     assert again.exchange_codec == "pack" and again.exchange_stages == 4
-    old = {k: v for k, v in doc.items()
+    assert again.predicted_terms == {"shuffle": 1.5}
+    # a v3 file (pre-audit) has no predicted_terms: empty table on load
+    v3 = {k: v for k, v in doc.items() if k != "predicted_terms"}
+    v3["schema_version"] = 3
+    assert JoinPlan.from_dict(v3).predicted_terms == {}
+    assert JoinPlan.from_dict(v3).exchange_codec == "pack"
+    old = {k: v for k, v in v3.items()
            if k not in ("exchange_codec", "exchange_stages")}
     old["schema_version"] = 2
     assert JoinPlan.from_dict(old).exchange_codec == "off"

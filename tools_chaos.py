@@ -69,8 +69,12 @@ def main(argv=None) -> int:
     from tpu_radix_join.robustness import chaos
 
     verify = "off" if args.demo_shrink else args.verify
+    # violations drop forensics bundles (observability/postmortem.py)
+    # next to the repro JSONs; the repro line names its bundle path
     runner = chaos.ChaosRunner(num_nodes=args.nodes, size=args.size,
-                               verify=verify)
+                               verify=verify,
+                               bundle_dir=os.path.join(args.artifact_dir,
+                                                       "forensics"))
 
     def show(out):
         cls = f" class={out.failure_class}" if out.failure_class else ""
@@ -100,6 +104,9 @@ def main(argv=None) -> int:
         print("[CHAOS] repro " + chaos.write_repro(repro, path))
         print(f"[CHAOS] repro written to {path} "
               f"(shrunk {len(out.schedule.arms)} -> {len(shrunk.arms)} arms)")
+        if repro.bundle:
+            print(f"[CHAOS] forensics bundle {repro.bundle} "
+                  f"(render: python tools_postmortem.py {repro.bundle})")
     print("[CHAOS] " + json.dumps(summary, sort_keys=True))
     if args.demo_shrink:
         # demo mode: violations are the point; success = every shrunk

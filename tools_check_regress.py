@@ -30,6 +30,14 @@ or staging change that inflates the wire regresses even when the join
 stays correct and the wall time holds.  The BENCH headline ``value`` is
 the wire *reduction* ratio (raw 8 B per tuple over packed bytes per
 tuple), which keeps the headline higher-is-better like every other bench.
+
+The observability counters are pinned lower-is-better too: ``PLANDRIFT``
+(planner/audit.py — |actual - predicted| join time as a percent of the
+cost model's prediction) regresses when it GROWS, catching stale device
+profiles in CI before they surface as mispredicted plans; ``PMBUNDLE``
+(forensics bundles written) and ``WDOGTRIP`` (hang-watchdog trips) count
+deaths per round, so a bench round that starts emitting bundles fails
+the gate even if the surviving joins kept their speed.
 """
 
 import argparse

@@ -150,6 +150,16 @@ def emit_timeline(base_dir: str, out_path: str) -> int:
     print(f"wrote {out_path}: {len(md['ranks'])} rank(s), {spans} spans, "
           f"{instants} instant events on one clock "
           f"(t0={md['t0_epoch_s']:.3f}); load in Perfetto/chrome://tracing")
+    # a watchdog-killed / SIGKILLed rank leaves no (or a torn) span file;
+    # the merge is partial-tolerant, but the gap must be said out loud
+    if md.get("missing_ranks"):
+        print(f"WARNING: missing_ranks={md['missing_ranks']} — "
+              f"{len(md['missing_ranks'])} of {md['expected_ranks']} "
+              f"expected rank(s) left no readable span file; the timeline "
+              f"is PARTIAL", file=sys.stderr)
+    if md.get("corrupt_files"):
+        print(f"WARNING: skipped unreadable span file(s): "
+              f"{md['corrupt_files']}", file=sys.stderr)
     return 0
 
 

@@ -1,0 +1,117 @@
+"""Forensics-bundle renderer/merger CLI (observability/postmortem.py).
+
+    python tools_postmortem.py BUNDLE.json                # render one
+    python tools_postmortem.py forensics/                 # render each
+    python tools_postmortem.py forensics/ --merge         # fleet summary
+    python tools_postmortem.py a.json b.json --merge --json
+
+A *bundle* is the self-contained JSON a run emits on any terminal
+failure, deadline expiry, breaker trip, watchdog trip, or chaos
+violation: config fingerprint, JoinPlan, plan-vs-actual audit table,
+flight-recorder ring, heartbeat tail, thread stacks, chaos ``(seed,
+arms)``, env/backend info.  Rendering turns one bundle into a readable
+report; ``--merge`` summarizes many (counts by reason/failure class/
+rank, time range, one row per bundle) — the shape a fleet report wants
+before anyone opens individual bundles.
+
+Exits 0 on success, 1 when any input is unreadable, 2 on usage errors.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_radix_join.observability.postmortem import (list_bundles,
+                                                     load_bundle,
+                                                     merge_bundles,
+                                                     render_bundle)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools_postmortem.py",
+        description="Render or merge post-mortem forensics bundles.")
+    p.add_argument("paths", nargs="+",
+                   help="bundle file(s) and/or directories of bundles")
+    p.add_argument("--merge", action="store_true",
+                   help="cross-bundle summary instead of per-bundle "
+                        "rendering")
+    p.add_argument("--json", action="store_true",
+                   help="raw JSON output (merge summary, or the loaded "
+                        "bundles)")
+    p.add_argument("--ring-tail", type=int, default=20,
+                   help="flight-recorder records to show per bundle "
+                        "(default %(default)s)")
+    p.add_argument("--no-stacks", action="store_true",
+                   help="omit thread stacks from rendered output")
+    return p
+
+
+def _expand(paths) -> list:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            found = list_bundles(p)
+            if not found:
+                print(f"WARNING: no bundle_*.json under {p}",
+                      file=sys.stderr)
+            out.extend(found)
+        else:
+            out.append(p)
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    paths = _expand(args.paths)
+    if not paths:
+        print("error: no bundles to read", file=sys.stderr)
+        return 2
+    if args.merge:
+        summary = merge_bundles(paths)
+        if args.json:
+            print(json.dumps(summary, indent=2))
+        else:
+            print(f"{summary['bundles']} bundle(s), "
+                  f"{summary['t_first']} .. {summary['t_last']}")
+            print(f"by reason:        {summary['by_reason']}")
+            print(f"by failure class: {summary['by_failure_class']}")
+            print(f"by rank:          {summary['by_rank']}")
+            for row in summary["rows"]:
+                if "error" in row:
+                    print(f"  UNREADABLE {row['path']}: {row['error']}")
+                    continue
+                drift = (f" drift={row['drift_pct']}%"
+                         if row.get("drift_pct") is not None else "")
+                qid = (f" query={row['query_id']}"
+                       if row.get("query_id") else "")
+                print(f"  {row['path']}: {row['reason']} "
+                      f"[{row['failure_class']}] rank={row['rank']} "
+                      f"strategy={row.get('strategy')}{drift}{qid}")
+        bad = sum(1 for r in summary["rows"] if "error" in r)
+        return 1 if bad else 0
+    rc = 0
+    for i, path in enumerate(paths):
+        try:
+            bundle = load_bundle(path)
+        except (OSError, ValueError) as e:
+            print(f"error: unreadable bundle {path}: {e!r}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        if args.json:
+            print(json.dumps(bundle, indent=2))
+            continue
+        if i:
+            print()
+        print(f"# {path}")
+        print(render_bundle(bundle, ring_tail=args.ring_tail,
+                            stacks=not args.no_stacks))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -205,6 +205,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--breaker-cooldown-s", type=float, default=30.0,
                    help="serve mode: seconds the breaker stays open before "
                         "half-opening for a primary health probe")
+    p.add_argument("--watchdog-timeout", type=float, default=0.0,
+                   metavar="SEC",
+                   help="hang watchdog (observability/watchdog.py): when "
+                        "the flight recorder sees no progress for SEC "
+                        "seconds while a phase timer is open, dump every "
+                        "thread's stack + the ring into a forensics bundle "
+                        "and kill the join through the engine cancel hook "
+                        "(classified backend_unavailable); 0 = off")
+    p.add_argument("--forensics-dir", default=None,
+                   help="directory for post-mortem forensics bundles "
+                        "(observability/postmortem.py): any terminal "
+                        "classified failure or watchdog trip writes a "
+                        "self-contained bundle_*.json here (default: "
+                        "$TPU_RADIX_FORENSICS_DIR, else forensics/ under "
+                        "--output-dir or --timeline-dir when one is set)")
     p.add_argument("--pipeline-repeats", action="store_true",
                    help="dispatch the --repeat joins asynchronously and "
                         "fence once (amortized-throughput methodology, "
@@ -212,6 +227,48 @@ def build_parser() -> argparse.ArgumentParser:
                         "round-trip from the reported rate; no per-join "
                         "retry loop")
     return p
+
+
+def _forensics_dir(args):
+    """Resolve where forensics bundles land: explicit flag, then the
+    environment, then a ``forensics/`` subdir of whichever artifact dir
+    the run already writes — None (no bundles) only when the run has no
+    artifact dir at all."""
+    import os
+
+    d = (args.forensics_dir
+         or os.environ.get("TPU_RADIX_FORENSICS_DIR")
+         or (os.path.join(args.output_dir, "forensics")
+             if args.output_dir else None)
+         or (os.path.join(args.timeline_dir, "forensics")
+             if args.timeline_dir else None))
+    return d
+
+
+def _emit_failure_bundle(meas, exc, args, reason="failure"):
+    """Write a forensics bundle for a terminal classified failure.
+
+    A watchdog trip already wrote its bundle (the exception carries the
+    path); everything else gets one here.  Bundle emission must never
+    turn a classified failure into an unclassified crash — errors land
+    on stderr and the original failure proceeds."""
+    path = getattr(exc, "bundle", None)
+    if path:
+        return path
+    out_dir = _forensics_dir(args)
+    if not out_dir:
+        print("[FORENSICS] no bundle dir (--forensics-dir / --output-dir / "
+              "--timeline-dir all unset); skipping bundle", file=sys.stderr)
+        return None
+    try:
+        from tpu_radix_join.observability.postmortem import write_bundle
+        return write_bundle(
+            out_dir, meas, reason=reason,
+            failure_class=getattr(exc, "failure_class", None),
+            config=vars(args), extra={"error": repr(exc)})
+    except Exception as e:   # noqa: BLE001 - forensics must not mask
+        print(f"[FORENSICS] bundle write failed: {e!r}", file=sys.stderr)
+        return None
 
 
 def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
@@ -246,7 +303,10 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
     pipeline = args.grid_pipeline
     if pipeline == "auto" and plan is not None and plan.engine == "chunked":
         pipeline = plan.grid_pipeline
+    from tpu_radix_join.planner.audit import audit_plan, phase_snapshot
+
     meas.set_trace_tags(strategy="chunked_grid", engine="chunked")
+    times0 = phase_snapshot(meas)
     meas.start("JTOTAL")
     try:
         total = chunked_join_grid(
@@ -267,11 +327,21 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
         meas.meta["failure_class"] = cls
         print(f"[RESULTS] failure/failure_class: {cls}")
         print(f"[RESULTS] failure/error: {e}", file=sys.stderr)
+        bundle = _emit_failure_bundle(meas, e, args)
+        if bundle:
+            print(f"[FORENSICS] bundle {bundle}", file=sys.stderr)
         if args.output_dir:
             path = meas.store(args.output_dir)
             print(f"[PERF] stored {path}")
         return 1
     meas.stop("JTOTAL")
+    # plan-vs-actual: the grid engine's measured JTOTAL against the cost
+    # model's prediction for the chunked strategy (planner/audit.py)
+    audit = audit_plan(plan, meas, times0=times0)
+    if audit is not None:
+        print(f"[PLAN] actual_ms={audit['actual_ms']:.1f} "
+              f"predicted_ms={audit['predicted_ms']:.1f} "
+              f"drift={audit['drift_pct']:.1f}%")
     print(f"[RESULTS] Tuples: {total}")
     if expected is not None:
         status = "OK" if total == expected else "MISMATCH"
@@ -322,7 +392,8 @@ def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s)
     session = JoinSession(cfg, svc, measurements=meas,
-                          plan_cache=plan_cache, profile=args.profile)
+                          plan_cache=plan_cache, profile=args.profile,
+                          forensics_dir=_forensics_dir(args))
     if sampler is not None:
         # heartbeat ticks carry the live SLO/breaker snapshot in serve mode
         sampler.extra = session._heartbeat_extra
@@ -345,18 +416,21 @@ def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
             line = line.strip()
             if not line or line.startswith("#"):
                 continue
+            qid = None
             try:
                 obj = _json.loads(line)
                 if not isinstance(obj, dict):
                     raise ValueError("request must be a JSON object")
                 obj.setdefault("query_id", f"line{lineno}")
+                qid = obj.get("query_id")
                 request = QueryRequest.from_json(obj)
             except (ValueError, TypeError) as e:
                 # a malformed line is the CLIENT's bug: report it and keep
                 # serving — one bad request must not kill the session
                 errors += 1
                 print(_json.dumps({"event": "request_error",
-                                   "line": lineno, "error": str(e)}),
+                                   "line": lineno, "query_id": qid,
+                                   "error": str(e)}),
                       flush=True)
                 continue
             try:
@@ -483,6 +557,8 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
     # the driver behaves exactly as before.
     plan = None
     plan_cache = None
+    plan_costs = None
+    explain_tbl = None
     if args.plan is not None or args.plan_cache_dir:
         import dataclasses as _dc
 
@@ -515,6 +591,7 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
                 plan, _ = plan_cache.lookup(global_size, global_size, wl_fp)
             if plan is None:
                 plan, costs = plan_join(profile, workload)
+                plan_costs, explain_tbl = costs, explain_table
                 if args.plan == "explain":
                     print(explain_table(costs, plan))
                     return 0
@@ -593,13 +670,57 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
     # it into CTOTAL + the per-op table on exit (Measurements.trace).
     trace_ctx = (meas.trace(os.path.join(args.output_dir, "trace"))
                  if args.trace else contextlib.nullcontext())
-    with trace_ctx:
-        if args.pipeline_repeats and args.repeat > 1:
-            result = engine.join_arrays_pipelined(r_batch, s_batch,
-                                                  args.repeat)
-        else:
-            for i in range(args.repeat):
-                result = engine.join_arrays(r_batch, s_batch)
+    # hang watchdog (--watchdog-timeout): evidence first (stacks + bundle),
+    # then the kill through the engine cancel hook — a hung collective
+    # becomes a classified backend_unavailable exit, not a silent stall
+    from tpu_radix_join.observability.watchdog import Watchdog, engine_killer
+    from tpu_radix_join.planner.audit import (actuals_for_explain,
+                                              audit_plan, phase_snapshot)
+
+    wd_ctx = (Watchdog(meas, timeout_s=args.watchdog_timeout,
+                       kill=engine_killer(engine),
+                       bundle_dir=_forensics_dir(args), config=vars(args))
+              if args.watchdog_timeout > 0 else contextlib.nullcontext())
+    times0 = phase_snapshot(meas)
+    try:
+        with trace_ctx, wd_ctx:
+            if args.pipeline_repeats and args.repeat > 1:
+                result = engine.join_arrays_pipelined(r_batch, s_batch,
+                                                      args.repeat)
+            else:
+                for i in range(args.repeat):
+                    result = engine.join_arrays(r_batch, s_batch)
+    except Exception as e:
+        # terminal classified failure (watchdog trip, injected fault,
+        # corruption): exit with the machine-readable class + a forensics
+        # bundle; an unclassified exception stays a loud traceback
+        cls = getattr(e, "failure_class", None)
+        if cls is None:
+            raise
+        if "JTOTAL" in meas._starts:
+            meas.stop("JTOTAL")
+        meas.meta["failure_class"] = cls
+        print(f"[RESULTS] failure/failure_class: {cls}")
+        print(f"[RESULTS] failure/error: {e}", file=sys.stderr)
+        bundle = _emit_failure_bundle(meas, e, args)
+        if bundle:
+            print(f"[FORENSICS] bundle {bundle}", file=sys.stderr)
+        if args.output_dir:
+            path = meas.store(args.output_dir)
+            print(f"[PERF] stored {path}")
+        return 1
+    # plan-vs-actual audit (planner/audit.py): every planned join closes
+    # the loop on the PR 2 cost model — measured JTOTAL vs predicted_ms,
+    # PLANDRIFT gauge for the regress gate, and the explain table grows
+    # its actuals column for the strategy that actually ran
+    audit = audit_plan(plan, meas, repeats=args.repeat, times0=times0)
+    if audit is not None and jax.process_index() == 0:
+        print(f"[PLAN] actual_ms={audit['actual_ms']:.1f} "
+              f"predicted_ms={audit['predicted_ms']:.1f} "
+              f"drift={audit['drift_pct']:.1f}%")
+        if plan_costs is not None and explain_tbl is not None:
+            print(explain_tbl(plan_costs, plan,
+                              actuals=actuals_for_explain(audit)))
     # per-rank failure class rides the registry meta into the rank-0
     # aggregate report (performance.print_results): a multi-rank run where
     # one rank degraded must say so in the summary, not only in that

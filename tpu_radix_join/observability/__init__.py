@@ -12,9 +12,27 @@ Three pillars over the ``performance`` registry (ISSUE 3):
 
 Merging per-rank span files onto one aligned clock lives in
 :mod:`timeline` (driven by ``tools_make_report.py --emit-timeline``).
+
+The always-on black-box layer (ISSUE 8) adds three more:
+
+  * :mod:`flightrec` — bounded ring of recent spans/counter deltas/events
+    wired into every Measurements registry with no opt-in flag;
+  * :mod:`watchdog` — phase-progress monitor that converts a hung
+    collective into a classified ``backend_unavailable`` outcome through
+    the engine's cancel hook, dumping stacks + ring on the way;
+  * :mod:`postmortem` — self-contained forensics bundles on any terminal
+    failure, rendered/merged by ``tools_postmortem.py``.
 """
 
+from tpu_radix_join.observability.flightrec import (FlightRecorder,
+                                                    dump_all_stacks)
 from tpu_radix_join.observability.metrics import MetricsSampler, load_samples
+from tpu_radix_join.observability.postmortem import (build_bundle,
+                                                     list_bundles,
+                                                     load_bundle,
+                                                     merge_bundles,
+                                                     render_bundle,
+                                                     write_bundle)
 from tpu_radix_join.observability.regress import (check_files, check_result,
                                                   compare_tags, extract_tags,
                                                   format_table,
@@ -22,9 +40,14 @@ from tpu_radix_join.observability.regress import (check_files, check_result,
 from tpu_radix_join.observability.spans import SpanTracer
 from tpu_radix_join.observability.timeline import (find_span_files,
                                                    merge_timeline)
+from tpu_radix_join.observability.watchdog import (HangDetected, Watchdog,
+                                                   engine_killer)
 
 __all__ = [
-    "MetricsSampler", "SpanTracer", "check_files", "check_result",
-    "compare_tags", "extract_tags", "find_span_files", "format_table",
-    "load_samples", "merge_timeline", "parse_tag_thresholds",
+    "FlightRecorder", "HangDetected", "MetricsSampler", "SpanTracer",
+    "Watchdog", "build_bundle", "check_files", "check_result",
+    "compare_tags", "dump_all_stacks", "engine_killer", "extract_tags",
+    "find_span_files", "format_table", "list_bundles", "load_bundle",
+    "load_samples", "merge_bundles", "merge_timeline",
+    "parse_tag_thresholds", "render_bundle", "write_bundle",
 ]

@@ -97,6 +97,21 @@ class MetricsSampler:
                 rec["times_us"] = {k: round(v, 1)
                                    for k, v in m.times_us.items()}
                 rec["open_phases"] = sorted(m._starts)
+                # explicit exchange block: the cumulative WIREBYTES counter
+                # only lands after a join completes, so mid-join ticks fall
+                # back to the resolved plan's static geometry
+                # (meta["exchange_plan"], stamped at sizing time) — wire
+                # regressions stay visible live, not only in the summary
+                c = rec["counters"]
+                xp = m.meta.get("exchange_plan") or {}
+                if c.get("WIREBYTES") or xp:
+                    rec["exchange"] = {
+                        "wirebytes": int(c.get("WIREBYTES", 0)),
+                        "pack_ratio_pct": c.get(
+                            "PACKRATIO", xp.get("pack_ratio_pct")),
+                        "stages": c.get("XSTAGES", xp.get("stages")),
+                        "planned_wire_bytes": xp.get("wire_bytes"),
+                    }
             if self.extra is not None:
                 rec.update(self.extra())
         except Exception as e:     # a sampler tick must never kill the join

@@ -48,7 +48,15 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # a codec/staging regression even though the
                             # join may still pass
                             "wirebytes", "peak_exchange_bytes",
-                            "bytes_per_tuple")
+                            "bytes_per_tuple",
+                            # plan-vs-actual drift (planner/audit.py
+                            # PLANDRIFT gauge): a growing gap between the
+                            # cost model's prediction and the clock means
+                            # a stale device profile, even when absolute
+                            # perf holds.  Bundle/watchdog counters
+                            # (PMBUNDLE/WDOGTRIP) count deaths per round —
+                            # more of either is strictly worse.
+                            "plandrift", "pmbundle", "wdogtrip")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
 

@@ -85,12 +85,23 @@ def merge_timeline(timeline_dir: str, out_path: Optional[str] = None,
     given), or None when the directory holds no span files.  ``trace_dir``
     (default: ``timeline_dir`` itself) is scanned for xplane artifacts only
     for ranks whose span files embed no device summary.
+
+    Partial-tolerant by design: a rank killed mid-run (watchdog, SIGKILL)
+    leaves a truncated or absent span file, and the surviving ranks'
+    timeline is exactly what the post-mortem needs.  Unreadable files are
+    skipped but *named* (``metadata["corrupt_files"]``), and ranks absent
+    from a world whose size the tracer tags declare (``tags.nodes``) are
+    listed in ``metadata["missing_ranks"]`` so the merge says out loud
+    that it is partial instead of silently narrowing the world.
     """
     docs: List[Tuple[str, dict]] = []
+    corrupt: List[str] = []
     for path in find_span_files(timeline_dir):
         doc = _load(path)
         if doc is not None:
             docs.append((path, doc))
+        else:
+            corrupt.append(os.path.basename(path))
     if not docs:
         return None
 
@@ -146,6 +157,15 @@ def merge_timeline(timeline_dir: str, out_path: Optional[str] = None,
                 rank0, summary, min_host_ts.get(rank0, 0.0),
                 source=f"xplane scan of {scan}"))
 
+    # expected world size: the largest ``nodes`` tag any rank declared
+    # (Measurements.attach_tracer stamps it); 0 when no rank carried one
+    expected = 0
+    for info in ranks.values():
+        try:
+            expected = max(expected, int(info["tags"].get("nodes", 0)))
+        except (TypeError, ValueError):
+            pass
+    missing = sorted(set(range(expected)) - set(ranks))
     doc = {
         "traceEvents": merged,
         "displayTimeUnit": "ms",
@@ -153,6 +173,10 @@ def merge_timeline(timeline_dir: str, out_path: Optional[str] = None,
             "t0_epoch_s": t0,
             "ranks": {str(r): info for r, info in sorted(ranks.items())},
             "clock": "us since earliest rank epoch anchor",
+            "expected_ranks": expected or len(ranks),
+            "missing_ranks": missing,
+            "corrupt_files": corrupt,
+            "partial": bool(missing or corrupt),
         },
     }
     if out_path:

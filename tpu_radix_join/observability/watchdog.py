@@ -1,0 +1,163 @@
+"""Hang watchdog: phase-progress monitor over the flight recorder.
+
+The downed-tunnel failure mode (ROADMAP "Bench trajectory" rounds 3-5)
+is a collective that never completes: the host thread blocks inside a
+dispatch, no exception fires, and the run stalls silently until someone
+kills it by hand.  This monitor converts that into a *classified*
+``backend_unavailable`` outcome with forensics:
+
+  * **progress signal** — the Measurements flight recorder timestamps
+    every begin/end/incr/event; a phase timer left open
+    (``m._starts`` non-empty) while the ring goes quiet for
+    ``timeout_s`` means the pipeline stopped making progress;
+  * **evidence first** — on a trip the watchdog dumps every live
+    thread's stack and (when a forensics dir is known) writes a
+    post-mortem bundle BEFORE attempting the kill, so even a thread
+    that never reaches a cancel point leaves a black box behind;
+  * **kill path** — the engine's cooperative ``cancel`` hook
+    (operators/hash_join.py ``_check_cancel``): the watchdog rebinds it
+    to raise :class:`HangDetected` at the next phase boundary / stall
+    poll.  Rebinding over a deadline's hook is deliberate — once the
+    hang is established, the hang verdict outranks the budget clock.
+
+The watchdog is a daemon thread; ``stop()`` (or the context manager
+exit) joins it.  One trip per instance: after firing it only waits for
+``stop``.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from tpu_radix_join.observability.flightrec import dump_all_stacks
+
+#: mirrors robustness.retry.BACKEND_UNAVAILABLE without importing the
+#: robustness package from the observability layer (kept dependency-free
+#: so flightrec/watchdog can be wired into Measurements itself)
+BACKEND_UNAVAILABLE = "backend_unavailable"
+
+DEFAULT_TIMEOUT_S = 30.0
+
+
+class HangDetected(RuntimeError):
+    """A watched run made no recorded progress for the timeout window."""
+
+    failure_class = BACKEND_UNAVAILABLE
+
+    def __init__(self, idle_s: float, open_phases, bundle: Optional[str]):
+        phases = sorted(open_phases)
+        super().__init__(
+            f"watchdog: no progress for {idle_s:.1f}s with open phase(s) "
+            f"{phases}; classified {BACKEND_UNAVAILABLE}"
+            + (f"; bundle at {bundle}" if bundle else ""))
+        self.idle_s = idle_s
+        self.open_phases = phases
+        self.bundle = bundle
+
+
+class Watchdog:
+    """Monitor one Measurements registry for stalled progress.
+
+    ``kill(exc)`` is invoked once on trip with the :class:`HangDetected`
+    instance; use :func:`engine_killer` to target a HashJoin's ``cancel``
+    hook.  ``bundle_kw`` is forwarded to postmortem.write_bundle (plan,
+    config, chaos schedule, ...) so the bundle written at trip time is as
+    complete as the terminal-failure one.
+    """
+
+    def __init__(self, measurements, timeout_s: float = DEFAULT_TIMEOUT_S,
+                 kill: Optional[Callable] = None,
+                 bundle_dir: Optional[str] = None,
+                 poll_s: Optional[float] = None,
+                 **bundle_kw):
+        self.measurements = measurements
+        self.timeout_s = float(timeout_s)
+        self.kill = kill
+        self.bundle_dir = bundle_dir
+        self.bundle_kw = bundle_kw
+        # poll fast enough that a trip lands well inside one timeout
+        # window even for sub-second test timeouts
+        self.poll_s = poll_s if poll_s is not None \
+            else max(0.01, min(1.0, self.timeout_s / 5.0))
+        self.tripped = False
+        self.exc: Optional[HangDetected] = None
+        self.bundle_path: Optional[str] = None
+        self.stacks = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run,
+                                        name="join-watchdog", daemon=True)
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self) -> "Watchdog":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+
+    def __enter__(self) -> "Watchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -------------------------------------------------------------- monitor
+    def _run(self) -> None:
+        m = self.measurements
+        while not self._stop.wait(self.poll_s):
+            # progress = something recorded recently OR nothing in flight
+            # (an idle session between queries is not a hang)
+            if not m._starts:
+                continue
+            idle = m.flightrec.idle_s()
+            if idle >= self.timeout_s:
+                self._trip(idle)
+                return
+
+    def _trip(self, idle_s: float) -> None:
+        m = self.measurements
+        self.tripped = True
+        open_phases = list(m._starts)
+        self.stacks = dump_all_stacks()
+        from tpu_radix_join.performance.measurements import WDOGTRIP
+        m.incr(WDOGTRIP)
+        m.event("watchdog_trip", idle_s=round(idle_s, 3),
+                open_phases=sorted(open_phases),
+                failure_class=BACKEND_UNAVAILABLE)
+        if self.bundle_dir:
+            try:
+                from tpu_radix_join.observability.postmortem import \
+                    write_bundle
+                self.bundle_path = write_bundle(
+                    self.bundle_dir, measurements=m,
+                    reason="watchdog_trip",
+                    failure_class=BACKEND_UNAVAILABLE,
+                    stacks=self.stacks,
+                    extra={"idle_s": round(idle_s, 3),
+                           "open_phases": sorted(open_phases)},
+                    **self.bundle_kw)
+            except Exception as e:   # noqa: BLE001 — forensics must not
+                m.event("bundle_error", error=repr(e)[:200])  # mask the hang
+        self.exc = HangDetected(idle_s, open_phases, self.bundle_path)
+        if self.kill is not None:
+            try:
+                self.kill(self.exc)
+            except Exception as e:   # noqa: BLE001
+                m.event("watchdog_kill_error", error=repr(e)[:200])
+
+
+def engine_killer(engine) -> Callable:
+    """Kill-path factory for a HashJoin-like engine: rebinds the
+    cooperative ``cancel`` hook so the hung thread raises the watchdog's
+    exception at its next ``_check_cancel`` (phase boundary or stall
+    poll)."""
+
+    def _kill(exc: HangDetected) -> None:
+        def _raise(phase: str, _exc=exc):
+            raise _exc
+        engine.cancel = _raise
+
+    return _kill

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import os
 import time
 from typing import NamedTuple, Optional
 
@@ -71,8 +72,9 @@ from tpu_radix_join.parallel.network_partitioning import (network_partition,
                                                           receive_checksums)
 from tpu_radix_join.parallel.window import (ExchangeResult, Window,
                                             parse_exchange_mode)
-from tpu_radix_join.performance.measurements import (BACKOFFMS, RETRYN, VCHK,
-                                                     VCHKN, VFAIL, VREPAIR)
+from tpu_radix_join.performance.measurements import (BACKOFFMS, PACKRATIO,
+                                                     RETRYN, VCHK, VCHKN,
+                                                     VFAIL, VREPAIR, XSTAGES)
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness import verify as _verify
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
@@ -1665,6 +1667,35 @@ class HashJoin:
               if m else contextlib.nullcontext()):
             self._xplan = self._resolve_exchange_plan(r, s)
         self._check_cancel("sized")
+        if m and not self._single_node_sort_probe():
+            # stamp the resolved wire geometry NOW, not only in
+            # _finish_join: a live heartbeat tick mid-join (or the last
+            # tick before a death) must show the exchange plan even
+            # though the cumulative WIREBYTES counter only lands after
+            # the pipeline completes.  _finish_join overwrites with the
+            # final (possibly regrown) capacities.
+            xs = self._exchange_stats(cap_r, cap_s)
+            m.meta["exchange_plan"] = xs
+            m.counters[PACKRATIO] = int(round(xs["pack_ratio_pct"]))
+            m.counters[XSTAGES] = int(xs["stages"])
+        if _faults.fires(_faults.BACKEND_STALL, m):
+            # simulated hung collective (the downed-tunnel failure mode):
+            # spin without recording progress — exactly what a blocked
+            # dispatch looks like to the flight recorder — while still
+            # consulting the cancel hook, the watchdog's kill path.  The
+            # env-tunable cap keeps an unwatched test from hanging
+            # tier-1 forever; hitting it classifies as the transient
+            # infrastructure failure a real stuck tunnel would be.
+            cap_s_stall = float(os.environ.get("TPU_RADIX_STALL_CAP_S",
+                                               "120"))
+            t0_stall = time.monotonic()
+            while True:
+                self._check_cancel("stalled")
+                if time.monotonic() - t0_stall >= cap_s_stall:
+                    if m is not None and "JTOTAL" in m._starts:
+                        m.stop("JTOTAL")
+                    raise _faults.TransientFault(_faults.BACKEND_STALL, 1)
+                time.sleep(0.01)
         # integrity verification (robustness/verify.py): fingerprint the
         # pristine inputs before anything can damage them.  The n==1 sort
         # specialization performs no exchange (nothing to verify against)

@@ -92,6 +92,11 @@ QWARM = "QWARM"            # warm queries (capacity-cache hit: no sizing pass)
 QDEGRADED = "QDEGRADED"    # queries served by the degraded fallback engine
 BRKTRIP = "BRKTRIP"        # circuit-breaker trips (closed/half-open -> open)
 BRKPROBE = "BRKPROBE"      # half-open health probes dispatched
+PLANDRIFT = "PLANDRIFT"    # gauge: |actual - predicted| JTOTAL as a percent of
+                           # the planner's prediction (planner/audit.py) — the
+                           # plan-vs-actual closed-loop signal; lower is better
+WDOGTRIP = "WDOGTRIP"      # hang-watchdog trips (observability/watchdog.py)
+PMBUNDLE = "PMBUNDLE"      # forensics bundles written (observability/postmortem)
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second
@@ -125,6 +130,15 @@ class Measurements:
             "nodes": num_nodes,
             "epoch_s": time.time(),
         }
+        # always-on flight recorder (observability/flightrec.py): every
+        # start/stop/incr/event below mirrors into this bounded ring with
+        # no opt-in flag — the black box a post-mortem bundle freezes and
+        # the idle clock the hang watchdog polls.  Deliberately NOT gated
+        # on a tracer/config: the downed-tunnel failure mode left nothing
+        # behind precisely because recording was opt-in.
+        from tpu_radix_join.observability.flightrec import FlightRecorder
+        self.flightrec = FlightRecorder(epoch_s=self.meta["epoch_s"],
+                                        mono_s=self._mono0)
 
     # ------------------------------------------------------------ span tracer
     def attach_tracer(self, tracer=None, **tags):
@@ -153,15 +167,28 @@ class Measurements:
     def span(self, name: str, **args):
         """Timeline-only span context (grid pairs, checkpoint writes):
         shows on the trace without minting a ``times_us`` tag per instance
-        — per-pair tags would make .perf files unbounded."""
-        if self._tracer is None:
-            import contextlib
-            return contextlib.nullcontext()
-        return self._tracer.span(name, **args)
+        — per-pair tags would make .perf files unbounded.  Always mirrors
+        into the flight-recorder ring (the tracer remains opt-in)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self.flightrec.record("span", name, **args)
+            try:
+                if self._tracer is not None:
+                    with self._tracer.span(name, **args):
+                        yield
+                else:
+                    yield
+            finally:
+                self.flightrec.record("span_end", name)
+
+        return _ctx()
 
     # ----------------------------------------------------------------- timers
     def start(self, key: str) -> None:
         self._starts[key] = time.perf_counter()
+        self.flightrec.record("begin", key)
         if self._tracer is not None:
             self._tracer.begin(key)
 
@@ -174,6 +201,7 @@ class Measurements:
             jax.block_until_ready(fence)
         dt = (time.perf_counter() - self._starts.pop(key)) * 1e6
         self.times_us[key] += dt
+        self.flightrec.record("end", key, us=round(dt, 1))
         if self._tracer is not None:
             # the span records the real wall interval; exclude_from_running
             # shifts only the accumulated column (a compile excluded from
@@ -195,6 +223,7 @@ class Measurements:
 
     def incr(self, key: str, by: int = 1) -> None:
         self.counters[key] += by
+        self.flightrec.record("incr", key, by=by, total=self.counters[key])
 
     def event(self, name: str, **data) -> None:
         """Append a trace event to ``meta["events"]`` (lands in the
@@ -214,6 +243,7 @@ class Measurements:
                        "t_epoch_s": round(
                            self.meta["epoch_s"] + (now - self._mono0), 6),
                        **data})
+        self.flightrec.record("event", name, **data)
         if self._tracer is not None:
             self._tracer.instant(name, **data)
 
@@ -246,6 +276,12 @@ class Measurements:
             self.counters[XSTAGES] = int(stages)
         self.counters[WINCAPR] = cap_r
         self.counters[WINCAPS] = cap_s
+        # gauge assignments above bypass incr(); one ring record keeps the
+        # exchange geometry visible in the flight recorder too
+        self.flightrec.record(
+            "gauge", "exchange", wirebytes=self.counters[WIREBYTES],
+            pack_ratio_pct=self.counters.get(PACKRATIO),
+            stages=self.counters.get(XSTAGES))
 
     def derive_rates(self) -> None:
         """Derived throughput tags (the HILOCRATE/HOLOCRATE pattern,

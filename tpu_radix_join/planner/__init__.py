@@ -21,6 +21,8 @@ existed only as prose.  Here it lives in code:
     starts skip both planning and the engine's sizing pre-pass.
 """
 
+from tpu_radix_join.planner.audit import (actuals_for_explain, audit_plan,
+                                          phase_snapshot)
 from tpu_radix_join.planner.cache import PlanCache
 from tpu_radix_join.planner.cost_model import StrategyCost, Workload
 from tpu_radix_join.planner.plan import JoinPlan, explain_table, plan_join
@@ -29,5 +31,6 @@ from tpu_radix_join.planner.profile import (DeviceProfile, calibrate,
 
 __all__ = [
     "DeviceProfile", "JoinPlan", "PlanCache", "StrategyCost", "Workload",
-    "calibrate", "explain_table", "load_profile", "plan_join",
+    "actuals_for_explain", "audit_plan", "calibrate", "explain_table",
+    "load_profile", "phase_snapshot", "plan_join",
 ]

@@ -23,9 +23,12 @@ from tpu_radix_join.planner.profile import DeviceProfile
 
 # v2 adds ``grid_pipeline`` (the chunked engine's pipelined/synchronous
 # knob); v3 adds ``exchange_codec``/``exchange_stages`` (the bit-packed
-# wire codec and staged all_to_all).  Older files load with the fields'
-# defaults ("auto" pipeline, "off" codec, fused exchange).
-PLAN_SCHEMA_VERSION = 3
+# wire codec and staged all_to_all); v4 adds ``predicted_terms`` (the
+# winning row's per-term ms breakdown, the predicted half of the
+# plan-vs-actual audit — planner/audit.py).  Older files load with the
+# fields' defaults ("auto" pipeline, "off" codec, fused exchange, empty
+# term table).
+PLAN_SCHEMA_VERSION = 4
 
 
 class PlanError(ValueError):
@@ -56,6 +59,10 @@ class JoinPlan:
     pipeline_repeats: bool = False
     strategy: str = ""
     predicted_ms: float = 0.0
+    #: the winning StrategyCost row's per-term ms breakdown (sort, scan,
+    #: shuffle, ...) — what the plan-vs-actual audit compares measured
+    #: phase columns against
+    predicted_terms: dict = dataclasses.field(default_factory=dict)
     profile_name: str = ""
     schema_version: int = PLAN_SCHEMA_VERSION
 
@@ -134,6 +141,8 @@ def plan_join(profile: DeviceProfile, workload: Workload
               exchange_stages=xplan.stages,
               pipeline_repeats=workload.repeats > 1,
               strategy=best.strategy, predicted_ms=best.cost_ms,
+              predicted_terms={k: round(v, 4)
+                               for k, v in best.terms.items()},
               profile_name=profile.name)
     if best.strategy in ("chunked_grid", "chunked_grid_pipelined"):
         # the single-node grid engine never exchanges — keep the plan's
@@ -172,24 +181,39 @@ def _narrow(w: Workload) -> bool:
 
 
 def explain_table(costs: List[StrategyCost],
-                  chosen: Optional[JoinPlan] = None) -> str:
+                  chosen: Optional[JoinPlan] = None,
+                  actuals: Optional[dict] = None) -> str:
     """Human-readable per-strategy predicted-cost table (the ``--plan
     explain`` payload).  Terms are columns so a reader can line each up
-    against the measured phase columns in a chip perf artifact."""
+    against the measured phase columns in a chip perf artifact.
+
+    ``actuals`` (a plan-vs-actual audit summary — planner/audit.py
+    ``actuals_for_explain``) adds measured ``actual_ms``/``drift%``
+    columns, filled on the row of the strategy that actually ran."""
     term_keys: List[str] = []
     for c in costs:
         for k in c.terms:
             if k not in term_keys:
                 term_keys.append(k)
     header = (["strategy", "feasible", "predicted_ms"]
+              + (["actual_ms", "drift%"] if actuals else [])
               + [f"{k}_ms" for k in term_keys] + ["note"])
     rows = []
     for c in costs:
         mark = (" *" if chosen is not None and c.strategy == chosen.strategy
                 else "")
+        act_cells = []
+        if actuals:
+            if c.strategy == actuals.get("strategy"):
+                a, d = actuals.get("actual_ms"), actuals.get("drift_pct")
+                act_cells = [f"{a:.1f}" if a is not None else "-",
+                             f"{d:.1f}" if d is not None else "-"]
+            else:
+                act_cells = ["", ""]
         rows.append([c.strategy + mark,
                      "yes" if c.feasible else "NO",
                      f"{c.cost_ms:.1f}" if c.feasible else "-"]
+                    + act_cells
                     + [f"{c.terms[k]:.1f}" if k in c.terms else ""
                        for k in term_keys]
                     + [c.note])

@@ -59,6 +59,22 @@ CLASSIFIED = "classified"
 VIOLATION = "violation"
 
 
+def _violation_bundle(m, schedule: "Schedule", detail: str,
+                      bundle_dir: Optional[str]) -> Optional[str]:
+    """Forensics bundle for a soak VIOLATION: the run's registry + ring
+    plus the violating ``(seed, arms)`` schedule.  Never escalates — a
+    bundle-write error must not turn the harness's verdict into a crash."""
+    if not bundle_dir:
+        return None
+    try:
+        from tpu_radix_join.observability.postmortem import write_bundle
+        return write_bundle(bundle_dir, m, reason="chaos_violation",
+                            failure_class=None, chaos=schedule,
+                            extra={"detail": detail})
+    except Exception:           # noqa: BLE001 — forensics must not mask
+        return None
+
+
 @dataclasses.dataclass(frozen=True)
 class Schedule:
     """A replayable fault schedule: the injector seed plus the armed
@@ -96,11 +112,17 @@ class RunOutcome:
     failure_class: Optional[str]      # set when CLASSIFIED
     matches: Optional[int]            # set when the join returned
     detail: str = ""
+    bundle: Optional[str] = None      # forensics bundle path (violations)
 
     def to_json(self) -> Dict[str, Any]:
-        return {"schedule": self.schedule.to_json(), "status": self.status,
-                "failure_class": self.failure_class,
-                "matches": self.matches, "detail": self.detail}
+        out = {"schedule": self.schedule.to_json(), "status": self.status,
+               "failure_class": self.failure_class,
+               "matches": self.matches, "detail": self.detail}
+        if self.bundle:
+            # the repro artifact names the evidence next to the (seed,
+            # arms) pair; absent for non-violating runs (shape stable)
+            out["bundle"] = self.bundle
+        return out
 
 
 def generate_schedule(seed: int) -> Schedule:
@@ -136,11 +158,13 @@ class ChaosRunner:
 
     def __init__(self, num_nodes: int = 4, size: int = 1 << 12,
                  verify: str = "check", data_seed: int = 0,
-                 config_overrides: Optional[Dict[str, Any]] = None):
+                 config_overrides: Optional[Dict[str, Any]] = None,
+                 bundle_dir: Optional[str] = None):
         from tpu_radix_join.core.config import JoinConfig
         from tpu_radix_join.operators.hash_join import HashJoin
         from tpu_radix_join.performance.measurements import Measurements
         self._measurements_cls = Measurements
+        self.bundle_dir = bundle_dir
         self.oracle = size
         rng = np.random.default_rng(data_seed)
         self._rk = (rng.permutation(size) + 1).astype(np.uint32)
@@ -164,6 +188,14 @@ class ChaosRunner:
                            rid=jnp.asarray(self._rid), key_hi=None))
 
     def run(self, schedule: Schedule) -> RunOutcome:
+        out = self._run(schedule)
+        if out.status == VIOLATION:
+            out = dataclasses.replace(out, bundle=_violation_bundle(
+                self.measurements[-1], schedule, out.detail,
+                self.bundle_dir))
+        return out
+
+    def _run(self, schedule: Schedule) -> RunOutcome:
         m = self._measurements_cls()
         self.measurements.append(m)
         inj = faults.FaultInjector(seed=schedule.seed, measurements=m)
@@ -273,10 +305,12 @@ class SessionChaosRunner:
     def __init__(self, num_nodes: int = 4, size: int = 1 << 12,
                  verify: str = "check", queries: int = 6,
                  data_seed: int = 0,
-                 config_overrides: Optional[Dict[str, Any]] = None):
+                 config_overrides: Optional[Dict[str, Any]] = None,
+                 bundle_dir: Optional[str] = None):
         from tpu_radix_join.core.config import JoinConfig, ServiceConfig
         from tpu_radix_join.performance.measurements import Measurements
         self._measurements_cls = Measurements
+        self.bundle_dir = bundle_dir
         self.size = size
         self.queries = queries
         self.data_seed = data_seed
@@ -287,6 +321,14 @@ class SessionChaosRunner:
         self.measurements: List[Any] = []   # one registry per run, in order
 
     def run(self, schedule: Schedule) -> RunOutcome:
+        out = self._run(schedule)
+        if out.status == VIOLATION:
+            out = dataclasses.replace(out, bundle=_violation_bundle(
+                self.measurements[-1], schedule, out.detail,
+                self.bundle_dir))
+        return out
+
+    def _run(self, schedule: Schedule) -> RunOutcome:
         from tpu_radix_join.service import (UNCLASSIFIED, JoinSession,
                                             QueryRequest)
         m = self._measurements_cls()

@@ -47,10 +47,16 @@ CKPT_SAVE = "checkpoint.save"                  # checkpoint write I/O error
 CKPT_LOAD = "checkpoint.load"                  # checkpoint read I/O error
 BACKEND_DISPATCH = "backend.dispatch"          # per-query tunnel outage
                                                # (service/session.py probe)
+BACKEND_STALL = "backend.stall"                # simulated hung collective:
+                                               # the engine spins (checking
+                                               # its cancel hook) instead of
+                                               # raising — the watchdog's
+                                               # downed-tunnel failure mode
+                                               # (operators/hash_join.py)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
-         CKPT_LOAD, BACKEND_DISPATCH)
+         CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL)
 
 
 class InjectedFault(RuntimeError):

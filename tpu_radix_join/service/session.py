@@ -109,10 +109,14 @@ class QueryOutcome:
     warm: bool = False              # sizing pre-pass skipped (cache hit)
     breaker_state: str = "closed"
     detail: str = ""
+    bundle: Optional[str] = None    # forensics bundle path, failed queries
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
         out["latency_ms"] = round(self.latency_ms, 3)
+        if out.get("bundle") is None:
+            # successful queries keep the pre-forensics line shape
+            out.pop("bundle", None)
         return out
 
 
@@ -129,12 +133,18 @@ class JoinSession:
     def __init__(self, config: JoinConfig,
                  service: Optional[ServiceConfig] = None,
                  measurements=None, plan_cache=None, profile: str = "v5e_lite",
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 forensics_dir: Optional[str] = None):
         from tpu_radix_join.operators.hash_join import HashJoin
 
         self.config = config
         self.service = service or ServiceConfig()
         self.measurements = measurements
+        #: when set, every executed-and-failed query (deadline expiry,
+        #: backend outage, breaker trip, corruption) drops a forensics
+        #: bundle here (observability/postmortem.py), stamped with the
+        #: query_id the flight-recorder context carried during the query
+        self.forensics_dir = forensics_dir
         self._cache_tmp = None
         if plan_cache is None:
             # a resident session warms by default: without a caller-provided
@@ -282,6 +292,11 @@ class JoinSession:
                        probe=probing)
                 if m is not None else _null_ctx())
         engine.cancel = deadline.check
+        if m is not None:
+            # every ring record and counter delta inside this query carries
+            # the query_id: a bundle cut mid-serve attributes its evidence
+            m.flightrec.set_context(query_id=request.query_id,
+                                    tenant=request.tenant)
         status, cls, detail = "ok", OK, ""
         matches = expected = None
         try:
@@ -332,6 +347,7 @@ class JoinSession:
         finally:
             engine.cancel = None
         latency_ms = (time.perf_counter() - t0) * 1e3
+        trips0 = self.breaker.trips
         # warm = the sizing pre-pass did not run this query (plan-cache /
         # hot-layer capacity hit): the observable the acceptance criteria
         # gate on, measured from the JHIST column's delta
@@ -348,18 +364,46 @@ class JoinSession:
                 self.breaker.record_success()
             else:
                 self.breaker.record_failure(cls)
+        bundle = None
+        if status == "failed" and self.forensics_dir:
+            reason = ("breaker_trip" if self.breaker.trips > trips0
+                      else ("deadline_exceeded" if cls == DEADLINE_EXCEEDED
+                            else "query_failed"))
+            bundle = self._write_bundle(request, reason, cls, detail)
+        if m is not None:
+            m.flightrec.clear_context("query_id", "tenant")
         out = QueryOutcome(
             query_id=request.query_id, tenant=request.tenant,
             status=status, failure_class=cls, latency_ms=latency_ms,
             matches=matches, expected=expected,
             engine="primary" if primary else "cpu_fallback",
             degraded=not primary, warm=warm,
-            breaker_state=self.breaker.state, detail=detail)
+            breaker_state=self.breaker.state, detail=detail,
+            bundle=bundle)
         self.slo.record(request.tenant, latency_ms, ok=(status == "ok"),
                         failure_class=None if cls == OK else cls,
                         degraded=not primary)
         self.outcomes.append(out)
         return out
+
+    def _write_bundle(self, request: QueryRequest, reason: str,
+                      cls: str, detail: str) -> Optional[str]:
+        """Forensics bundle for one failed query.  Must never escalate:
+        a bundle-write error is an event on the registry, not a new
+        failure for the query (the isolation boundary stays sealed)."""
+        try:
+            from tpu_radix_join.observability.postmortem import write_bundle
+            return write_bundle(
+                self.forensics_dir, self.measurements, reason=reason,
+                failure_class=cls, config=self.config,
+                extra={"query_id": request.query_id,
+                       "tenant": request.tenant,
+                       "breaker_state": self.breaker.state,
+                       "detail": detail})
+        except Exception as e:     # noqa: BLE001 — forensics must not mask
+            if self.measurements is not None:
+                self.measurements.event("bundle_error", error=repr(e)[:200])
+            return None
 
     # ----------------------------------------------------------- lifecycle
     def attach_heartbeat(self, path: str, interval_s: float):
