@@ -53,6 +53,29 @@ class _BackendDown(ConnectionError):
     """One backend probe failed (tunnel down / device init error)."""
 
 
+#: cross-run ledger destination (--ledger-dir / $TPU_RADIX_LEDGER_DIR);
+#: set by main(), consumed by _ledger_append after every BENCH JSON line
+_LEDGER_DIR = None
+
+
+def _ledger_append(result):
+    """Mirror the BENCH result line into the cross-run telemetry ledger
+    (observability/ledger.py) so tools_profile_fit.py can fit constants
+    from live rounds without the report-time backfill.  Off unless a
+    ledger dir is configured; a ledger failure never fails the bench."""
+    if not _LEDGER_DIR:
+        return
+    try:
+        from tpu_radix_join.observability.ledger import Ledger, bench_payload
+        payload = bench_payload(result)
+        if payload is not None:
+            led = Ledger(_LEDGER_DIR)
+            led.append("bench", payload)
+            print(f"note: ledger row -> {led.path}", file=sys.stderr)
+    except Exception as e:   # noqa: BLE001 — telemetry must not sink a bench
+        print(f"note: ledger append failed: {e!r}", file=sys.stderr)
+
+
 def _planned_strategy(size, iters):
     """What the planner would run for the bench workload (pure host math —
     needs no live backend).  Stamped into the BENCH json on success AND on
@@ -311,6 +334,7 @@ def _run_grid_bench(check_baseline=None):
         "sortreuse": stats["on"]["sortreuse"],
     }
     print(json.dumps(result))
+    _ledger_append(result)
     if check_baseline:
         from tpu_radix_join.observability.regress import check_result
         code, report = check_result(result, check_baseline)
@@ -394,6 +418,7 @@ def _run_exchange_bench(check_baseline=None):
         "wall_pack_ms": round(pack["wall_s"] * 1e3, 1),
     }
     print(json.dumps(result))
+    _ledger_append(result)
     if check_baseline:
         from tpu_radix_join.observability.regress import check_result
         code, report = check_result(result, check_baseline)
@@ -506,6 +531,7 @@ def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
         "chaos": chaos,
     }
     print(json.dumps(result))
+    _ledger_append(result)
     if check_baseline:
         from tpu_radix_join.observability.regress import check_result
         code, report = check_result(result, check_baseline)
@@ -521,6 +547,15 @@ def main():
     argv = sys.argv[1:]
     # forensics bundles (observability/postmortem.py): every bench death
     # path — chaos violations, backend-probe exhaustion — drops one here
+    global _LEDGER_DIR
+    _LEDGER_DIR = os.environ.get("TPU_RADIX_LEDGER_DIR")
+    if "--ledger-dir" in argv:
+        i = argv.index("--ledger-dir")
+        if i + 1 >= len(argv):
+            print("error: --ledger-dir needs a directory path",
+                  file=sys.stderr)
+            sys.exit(2)
+        _LEDGER_DIR = argv[i + 1]
     forensics_dir = os.environ.get("TPU_RADIX_FORENSICS_DIR")
     if "--forensics-dir" in argv:
         i = argv.index("--forensics-dir")
@@ -790,6 +825,7 @@ def main():
         "value": round(tuples_per_sec, 1),
         "unit": "tuples/sec",
         "vs_baseline": round(tuples_per_sec / 1e9, 4),
+        "size": size,
         "sort_gbps": round(sort_gbps, 1),
         "hbm_envelope_gbps": 105.0,
         "sort_gbps_source": sort_src,
@@ -797,6 +833,7 @@ def main():
         "planned": planned,
     }
     print(json.dumps(result))
+    _ledger_append(result)
     if check_baseline:
         from tpu_radix_join.observability.regress import check_result
         code, report = check_result(result, check_baseline)

@@ -38,6 +38,13 @@ profiles in CI before they surface as mispredicted plans; ``PMBUNDLE``
 (forensics bundles written) and ``WDOGTRIP`` (hang-watchdog trips) count
 deaths per round, so a bench round that starts emitting bundles fails
 the gate even if the surviving joins kept their speed.
+
+The calibration-loop tags are pinned lower-is-better as well:
+``NCOMPILE`` / ``COMPILEMS`` (backend compiles seen via jax.monitoring —
+observability/compilemon.py) regress when a round starts recompiling
+warm shapes; ``fit_residual`` and ``stale_constants``
+(tools_profile_fit.py) regress when the fitted profile's spread grows or
+more constants drift away from the clock.
 """
 
 import argparse

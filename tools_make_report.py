@@ -4,6 +4,7 @@
     python tools_make_report.py artifacts/chip_r5 --emit-profile out.json \
         [--profile-name v5e_r5]
     python tools_make_report.py artifacts/chip_r5 --emit-timeline out.json
+    python tools_make_report.py artifacts --emit-ledger artifacts/ledger
 
 Reads every perf dir (`<rank>.perf`/`<rank>.info`), trace breakdown
 (`trace_*/breakdown.json`), and task log under the artifact dir and prints a
@@ -23,6 +24,14 @@ measure keep the base profile's committed values + citations.
 ``--timeline-dir`` run left under the artifact dir into one Chrome-trace
 JSON on a shared clock (observability.timeline.merge_timeline) — load the
 output in Perfetto / chrome://tracing.
+
+``--emit-ledger OUT`` backfills the cross-run telemetry ledger
+(observability/ledger.py) from committed history: every ``BENCH_r*.json``
+at the repo root becomes a ``kind="bench"`` row and every ``perf_*`` dir
+under the artifact dir (one nesting level allowed) a ``kind="run"`` row,
+timestamped by file mtime.  The backfilled ledger is what
+``tools_profile_fit.py fit`` turns into a provenance-carrying schema-v3
+profile without a single fresh chip run.
 """
 
 import glob
@@ -163,9 +172,24 @@ def emit_timeline(base_dir: str, out_path: str) -> int:
     return 0
 
 
+def emit_ledger(base_dir: str, out_path: str) -> int:
+    """Backfill the cross-run ledger from committed BENCH/perf history."""
+    from tpu_radix_join.observability.ledger import Ledger, ingest_artifacts
+
+    counts = ingest_artifacts(base_dir, out_path)
+    total = counts["bench"] + counts["run"]
+    print(f"wrote {Ledger(out_path).path}: {counts['bench']} bench row(s), "
+          f"{counts['run']} run row(s)")
+    if total == 0:
+        print(f"WARNING: nothing to ingest under {base_dir} (and no "
+              f"BENCH_r*.json at the repo root)", file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     argv = sys.argv[1:]
-    emit = prof_name = timeline = None
+    emit = prof_name = timeline = ledger = None
     if "--emit-profile" in argv:
         i = argv.index("--emit-profile")
         emit = argv[i + 1]
@@ -178,7 +202,13 @@ def main() -> int:
         i = argv.index("--emit-timeline")
         timeline = argv[i + 1]
         del argv[i:i + 2]
+    if "--emit-ledger" in argv:
+        i = argv.index("--emit-ledger")
+        ledger = argv[i + 1]
+        del argv[i:i + 2]
     base = argv[0] if argv else "artifacts/chip_r5"
+    if ledger is not None:
+        return emit_ledger(base, ledger)
     if timeline is not None:
         return emit_timeline(base, timeline)
     if emit is not None:

@@ -1,0 +1,175 @@
+"""Profile fit/refresh/diff CLI: turn ledger evidence into device profiles.
+
+    python tools_profile_fit.py fit --ledger artifacts/ledger
+    python tools_profile_fit.py fit --ledger artifacts/ledger \
+        --base v5e_lite --out artifacts/ledger/profile_fitted.json \
+        --min-samples 2
+    python tools_profile_fit.py refresh --ledger artifacts/ledger
+    python tools_profile_fit.py diff v5e_lite artifacts/ledger/profile_fitted.json
+
+``fit`` robust-fits every REQUIRED_CONSTANT the ledger has enough samples
+for (planner/calibrate.py) and writes a schema-v3 profile whose
+per-constant provenance blocks cite run ids, sample count, 95% CI, fit
+residual, and freshness; the default --out is the
+``profile_fitted.json`` that ``--profile auto`` prefers while fresh.
+Under-sampled fits are REFUSED (exit 2), never silently padded — a
+profile that merely echoes its base under a ``fit`` label would poison
+the provenance chain.
+
+``refresh`` runs staleness detection (persistent PLANDRIFT attributed to
+each drifting plan's dominant cost term) and re-fits; exit 1 when stale
+constants were found (evidence the committed snapshot has aged), 0 when
+the profile is clean.
+
+``diff`` prints the per-constant relative-delta table between two
+profiles (names or paths); exit 1 when any constant moved past
+--threshold — the same exit discipline as tools_check_regress.py, so CI
+can gate on "the fitted profile agrees with the committed one".
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from tpu_radix_join.observability.ledger import default_ledger_dir, load_rows
+from tpu_radix_join.planner.calibrate import (DEFAULT_DRIFT_THRESHOLD_PCT,
+                                              DEFAULT_MIN_PERSIST,
+                                              DEFAULT_MIN_SAMPLES,
+                                              UnderSampledError,
+                                              detect_stale, diff_profiles,
+                                              fit_profile)
+from tpu_radix_join.planner.profile import (FITTED_PROFILE_BASENAME,
+                                            ProfileError, format_provenance,
+                                            load_profile)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools_profile_fit.py",
+        description="Fit, refresh, or diff device profiles from a "
+                    "telemetry ledger.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def ledger_args(sp):
+        sp.add_argument("--ledger", default=None, metavar="DIR_OR_FILE",
+                        help="ledger dir or .jsonl (default: "
+                             "$TPU_RADIX_LEDGER_DIR or artifacts/ledger)")
+        sp.add_argument("--base", default="v5e_lite",
+                        help="base profile name/path for unfitted constants "
+                             "(default %(default)s)")
+        sp.add_argument("--min-samples", type=int,
+                        default=DEFAULT_MIN_SAMPLES,
+                        help="refuse to fit a constant from fewer samples "
+                             "(default %(default)s)")
+
+    f = sub.add_parser("fit", help="fit a profile from ledger samples")
+    ledger_args(f)
+    f.add_argument("--out", default=None,
+                   help="output profile path (default "
+                        f"<ledger dir>/{FITTED_PROFILE_BASENAME})")
+    f.add_argument("--name", default=None, help="fitted profile name")
+
+    r = sub.add_parser("refresh",
+                       help="detect stale constants and re-fit")
+    ledger_args(r)
+    r.add_argument("--out", default=None,
+                   help="output profile path (default "
+                        f"<ledger dir>/{FITTED_PROFILE_BASENAME})")
+    r.add_argument("--name", default=None, help="fitted profile name")
+    r.add_argument("--drift-threshold", type=float,
+                   default=DEFAULT_DRIFT_THRESHOLD_PCT,
+                   help="PLANDRIFT percent that counts as a miss "
+                        "(default %(default)s)")
+    r.add_argument("--min-persist", type=int, default=DEFAULT_MIN_PERSIST,
+                   help="misses before a constant is stale "
+                        "(default %(default)s)")
+
+    d = sub.add_parser("diff", help="per-constant delta between profiles")
+    d.add_argument("a", help="profile name or path (reference)")
+    d.add_argument("b", help="profile name or path (candidate)")
+    d.add_argument("--threshold", type=float, default=0.25,
+                   help="relative delta past which exit is 1 "
+                        "(default %(default)s)")
+    return p
+
+
+def _resolve_ledger(args) -> str:
+    return args.ledger or default_ledger_dir()
+
+
+def _fit(args, stale=None) -> int:
+    ledger = _resolve_ledger(args)
+    rows = load_rows(ledger)
+    if not rows:
+        print(f"error: no ledger rows at {ledger}", file=sys.stderr)
+        return 2
+    try:
+        base = load_profile(args.base)
+        prof, fits = fit_profile(rows, base=base, name=args.name,
+                                 min_samples=args.min_samples)
+    except UnderSampledError as e:
+        print(f"error: under-sampled fit refused: {e}", file=sys.stderr)
+        return 2
+    except (ProfileError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    out = args.out or os.path.join(
+        ledger if not ledger.endswith(".jsonl") else os.path.dirname(ledger),
+        FITTED_PROFILE_BASENAME)
+    try:
+        prof.save(out)
+    except OSError as e:
+        print(f"error: cannot write {out}: {e}", file=sys.stderr)
+        return 2
+    print(f"fitted {len(fits)}/{len(prof.constants)} constants from "
+          f"{len(rows)} ledger rows -> {out}")
+    print(format_provenance(prof, stale=stale))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == "fit":
+        return _fit(args)
+    if args.cmd == "refresh":
+        ledger = _resolve_ledger(args)
+        stale = detect_stale(load_rows(ledger),
+                             threshold_pct=args.drift_threshold,
+                             min_persist=args.min_persist)
+        rc = _fit(args, stale=stale)
+        if rc != 0:
+            return rc
+        if stale:
+            names = ", ".join(sorted(stale))
+            print(f"stale constants re-fit: {names}")
+            return 1            # evidence found: the old profile had aged
+        print("no stale constants")
+        return 0
+    # diff
+    try:
+        a, b = load_profile(args.a), load_profile(args.b)
+    except ProfileError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    rows = diff_profiles(a, b)
+    worst = 0.0
+    print(f"{'constant':<24} {'a (' + a.name + ')':>20} "
+          f"{'b (' + b.name + ')':>20} {'rel_delta':>10}")
+    for r in rows:
+        rel = r["rel_delta"]
+        worst = max(worst, rel or 0.0)
+        print(f"{r['constant']:<24} "
+              f"{r['a'] if r['a'] is not None else '-':>20} "
+              f"{r['b'] if r['b'] is not None else '-':>20} "
+              f"{f'{rel:.1%}' if rel is not None else '-':>10}")
+    if worst > args.threshold:
+        print(f"max relative delta {worst:.1%} exceeds "
+              f"--threshold {args.threshold:.1%}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

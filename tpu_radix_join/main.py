@@ -173,9 +173,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "config change")
     p.add_argument("--profile", default="v5e_lite",
                    help="device profile for the planner: a packaged name "
-                        "(profiles/*.json) or a JSON path, e.g. one from "
+                        "(profiles/*.json), a JSON path (e.g. from "
                         "tools_make_report.py --emit-profile or "
-                        "planner.calibrate()")
+                        "tools_profile_fit.py), or 'auto' — prefer the "
+                        "ledger's fitted profile_fitted.json while fresh, "
+                        "else the committed snapshot")
+    p.add_argument("--ledger-dir", default=None,
+                   help="append this run's distilled telemetry (phase "
+                        "times, counters, plan-vs-actual, fingerprint) to "
+                        "the cross-run ledger here at exit "
+                        "(observability/ledger.py; default: "
+                        "$TPU_RADIX_LEDGER_DIR, else off).  The ledger "
+                        "feeds tools_profile_fit.py and --profile auto")
     p.add_argument("--serve", default=None, metavar="FILE",
                    help="resident service mode (tpu_radix_join.service): "
                         "read one JSON query request per line from FILE "
@@ -243,6 +252,32 @@ def _forensics_dir(args):
          or (os.path.join(args.timeline_dir, "forensics")
              if args.timeline_dir else None))
     return d
+
+
+def _ledger_dir(args):
+    """The cross-run ledger location: explicit flag, then the environment
+    — None means this run keeps no ledger (the pre-ledger default)."""
+    import os
+
+    return args.ledger_dir or os.environ.get("TPU_RADIX_LEDGER_DIR")
+
+
+def _ledger_flush(args, meas):
+    """Append this run's distilled registry to the cross-run ledger at
+    exit.  Runs that measured nothing (--plan explain, argparse errors)
+    skip silently; a ledger write failure must never change the run's
+    exit code — the ledger is memory, not a dependency."""
+    d = _ledger_dir(args)
+    if not d or (not meas.times_us and not meas.counters):
+        return
+    try:
+        from tpu_radix_join.observability.ledger import Ledger, run_payload
+        led = Ledger(d)
+        row = led.append("run", run_payload(meas))
+        print(f"[OBS] ledger row {row['run_id']} -> {led.path}",
+              file=sys.stderr)
+    except Exception as e:   # noqa: BLE001 — telemetry must not fail the run
+        print(f"[OBS] ledger append failed: {e!r}", file=sys.stderr)
 
 
 def _emit_failure_bundle(meas, exc, args, reason="failure"):
@@ -391,9 +426,15 @@ def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
         default_deadline_s=args.serve_deadline_s,
         breaker_threshold=args.breaker_threshold,
         breaker_cooldown_s=args.breaker_cooldown_s)
+    ledger = None
+    ld = _ledger_dir(args)
+    if ld:
+        from tpu_radix_join.observability.ledger import Ledger
+        ledger = Ledger(ld)
     session = JoinSession(cfg, svc, measurements=meas,
                           plan_cache=plan_cache, profile=args.profile,
-                          forensics_dir=_forensics_dir(args))
+                          forensics_dir=_forensics_dir(args),
+                          ledger=ledger)
     if sampler is not None:
         # heartbeat ticks carry the live SLO/breaker snapshot in serve mode
         sampler.extra = session._heartbeat_extra
@@ -467,6 +508,12 @@ def main(argv=None) -> int:
     import contextlib
     import os
 
+    if args.profile == "auto":
+        # resolve BEFORE jax init: the decision reads only the ledger dir
+        from tpu_radix_join.planner.profile import resolve_profile
+        args.profile = resolve_profile("auto", ledger_dir=_ledger_dir(args))
+        print(f"[PROFILE] auto -> {args.profile}", file=sys.stderr)
+
     import jax
 
     from tpu_radix_join.utils.platform import apply_platform_override
@@ -509,6 +556,13 @@ def main(argv=None) -> int:
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)
 
+    # compile telemetry: every backend compile lands in NCOMPILE/COMPILEMS
+    # via jax.monitoring (observability/compilemon.py) — heartbeat ticks,
+    # the ledger row, and the regress gate all see compile churn
+    from tpu_radix_join.observability.compilemon import (
+        install_compile_monitor, uninstall_compile_monitor)
+    install_compile_monitor(meas)
+
     # ---------------------------------------------------- observability
     # (tpu_radix_join.observability): opt-in span timeline + live metrics
     # heartbeat; without the flags the driver behaves exactly as before.
@@ -532,8 +586,10 @@ def main(argv=None) -> int:
             return _run_serve(args, cfg, meas, nodes, sampler=sampler)
         return _run_driver(args, cfg, meas, distributed, nodes)
     finally:
+        uninstall_compile_monitor(meas)
         if sampler is not None:
             sampler.stop()
+        _ledger_flush(args, meas)
         if tracer is not None:
             # save in the finally: a failed/degraded run's timeline is the
             # one a post-mortem needs most
@@ -594,6 +650,18 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
                 plan_costs, explain_tbl = costs, explain_table
                 if args.plan == "explain":
                     print(explain_table(costs, plan))
+                    # constants half of explain: where each profile
+                    # constant came from (fit provenance vs committed
+                    # citation) and which ones the ledger's accumulated
+                    # PLANDRIFT says have gone stale
+                    from tpu_radix_join.observability.ledger import (
+                        default_ledger_dir, load_rows)
+                    from tpu_radix_join.planner.calibrate import detect_stale
+                    from tpu_radix_join.planner.profile import \
+                        format_provenance
+                    ld = _ledger_dir(args) or default_ledger_dir()
+                    print(format_provenance(
+                        profile, stale=detect_stale(load_rows(ld))))
                     return 0
                 if plan_cache is not None:
                     plan_cache.store(global_size, global_size, wl_fp,

@@ -13,6 +13,17 @@ Three pillars over the ``performance`` registry (ISSUE 3):
 Merging per-rank span files onto one aligned clock lives in
 :mod:`timeline` (driven by ``tools_make_report.py --emit-timeline``).
 
+The cross-run memory layer (ISSUE 9) adds two:
+
+  * :mod:`ledger` — append-only schema-versioned JSONL store of per-run
+    observations (phase spans, counters, plan-vs-actual tables, bench
+    lines, query outcomes, stack fingerprints), written at run end and
+    backfillable from committed artifacts; feeds the profile
+    auto-calibration loop in ``planner/calibrate.py``;
+  * :mod:`compilemon` — jax.monitoring listener mirroring every backend
+    compile into the NCOMPILE/COMPILEMS counters (recompile-storm canary
+    for ``--serve``).
+
 The always-on black-box layer (ISSUE 8) adds three more:
 
   * :mod:`flightrec` — bounded ring of recent spans/counter deltas/events
@@ -24,8 +35,14 @@ The always-on black-box layer (ISSUE 8) adds three more:
     failure, rendered/merged by ``tools_postmortem.py``.
 """
 
+from tpu_radix_join.observability.compilemon import (install_compile_monitor,
+                                                     uninstall_compile_monitor)
 from tpu_radix_join.observability.flightrec import (FlightRecorder,
                                                     dump_all_stacks)
+from tpu_radix_join.observability.ledger import (Ledger, bench_payload,
+                                                 default_ledger_dir,
+                                                 ingest_artifacts, load_rows,
+                                                 run_payload)
 from tpu_radix_join.observability.metrics import MetricsSampler, load_samples
 from tpu_radix_join.observability.postmortem import (build_bundle,
                                                      list_bundles,
@@ -44,10 +61,13 @@ from tpu_radix_join.observability.watchdog import (HangDetected, Watchdog,
                                                    engine_killer)
 
 __all__ = [
-    "FlightRecorder", "HangDetected", "MetricsSampler", "SpanTracer",
-    "Watchdog", "build_bundle", "check_files", "check_result",
-    "compare_tags", "dump_all_stacks", "engine_killer", "extract_tags",
-    "find_span_files", "format_table", "list_bundles", "load_bundle",
-    "load_samples", "merge_bundles", "merge_timeline",
-    "parse_tag_thresholds", "render_bundle", "write_bundle",
+    "FlightRecorder", "HangDetected", "Ledger", "MetricsSampler",
+    "SpanTracer", "Watchdog", "bench_payload", "build_bundle",
+    "check_files", "check_result", "compare_tags", "default_ledger_dir",
+    "dump_all_stacks", "engine_killer", "extract_tags", "find_span_files",
+    "format_table", "ingest_artifacts", "install_compile_monitor",
+    "list_bundles", "load_bundle", "load_rows", "load_samples",
+    "merge_bundles", "merge_timeline", "parse_tag_thresholds",
+    "render_bundle", "run_payload", "uninstall_compile_monitor",
+    "write_bundle",
 ]

@@ -24,6 +24,13 @@ from typing import Dict, Optional
 
 METRICS_SUFFIX = ".metrics.jsonl"
 
+#: rotation defaults: a long-lived serve session must not grow its
+#: heartbeat file unboundedly — at the cap the live file becomes
+#: ``<path>.1`` (older rotations shift to .2, .3, ... and the oldest
+#: beyond ``keep`` is dropped) and sampling continues into a fresh file
+DEFAULT_ROTATE_BYTES = 16 << 20
+DEFAULT_ROTATE_KEEP = 3
+
 
 def host_memory() -> Dict[str, int]:
     """VmSize/VmRSS in bytes from /proc (empty off-Linux)."""
@@ -58,13 +65,20 @@ class MetricsSampler:
     timeline and ``meta["events"]``."""
 
     def __init__(self, path: str, interval_s: float = 1.0,
-                 measurements=None, extra=None):
+                 measurements=None, extra=None,
+                 rotate_bytes: int = DEFAULT_ROTATE_BYTES,
+                 rotate_keep: int = DEFAULT_ROTATE_KEEP):
         if interval_s <= 0:
             raise ValueError("interval_s must be > 0")
         if extra is not None and not callable(extra):
             raise TypeError("extra must be a zero-arg callable or None")
+        if rotate_bytes <= 0 or rotate_keep < 1:
+            raise ValueError("rotate_bytes must be > 0 and rotate_keep >= 1")
         self.path = path
         self.interval_s = float(interval_s)
+        self.rotate_bytes = int(rotate_bytes)
+        self.rotate_keep = int(rotate_keep)
+        self.rotations = 0
         self.measurements = measurements
         #: zero-arg provider merged into every tick — the serve loop
         #: passes the session's SLO/breaker snapshot so ``tail -f`` shows
@@ -126,7 +140,31 @@ class MetricsSampler:
             f.write(json.dumps(rec) + "\n")
             f.flush()
             self.samples_written += 1
+            try:
+                if f.tell() >= self.rotate_bytes:
+                    self._rotate()
+            except Exception:   # rotation failure must never kill the join
+                pass
         return rec
+
+    def _rotate(self) -> None:
+        """Size-cap rotation: live file -> .1, .k -> .(k+1), the rotation
+        past ``rotate_keep`` dropped; sampling continues into a fresh live
+        file.  tail -f keeps following the live path (the fd reopens)."""
+        f, self._file = self._file, None
+        if f is not None:
+            f.close()
+        oldest = f"{self.path}.{self.rotate_keep}"
+        if os.path.exists(oldest):
+            os.remove(oldest)
+        for k in range(self.rotate_keep - 1, 0, -1):
+            src = f"{self.path}.{k}"
+            if os.path.exists(src):
+                os.replace(src, f"{self.path}.{k + 1}")
+        if os.path.exists(self.path):
+            os.replace(self.path, f"{self.path}.1")
+        self._file = open(self.path, "a")
+        self.rotations += 1
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "MetricsSampler":
@@ -167,17 +205,35 @@ class MetricsSampler:
         self.stop()
 
 
-def load_samples(path: str) -> list:
+def load_samples(path: str, include_rotated: bool = False) -> list:
     """Read a ``.metrics.jsonl`` back; unparseable lines (torn final write
-    of a killed run) are skipped."""
+    of a killed run) are skipped.  ``include_rotated`` prepends the
+    size-cap rotations (``<path>.N`` .. ``<path>.1``) oldest-first, so the
+    result stays chronological across the cap."""
+    paths = [path]
+    if include_rotated:
+        k = 1
+        older = []
+        while os.path.exists(f"{path}.{k}"):
+            older.append(f"{path}.{k}")
+            k += 1
+        paths = list(reversed(older)) + paths
     out = []
-    with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
+    for p in paths:
+        if p == path:
+            f = open(p)        # a missing live file stays an error
+        else:
             try:
-                out.append(json.loads(line))
-            except ValueError:
+                f = open(p)
+            except OSError:
                 continue
+        with f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except ValueError:
+                    continue
     return out

@@ -56,9 +56,21 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # perf holds.  Bundle/watchdog counters
                             # (PMBUNDLE/WDOGTRIP) count deaths per round —
                             # more of either is strictly worse.
-                            "plandrift", "pmbundle", "wdogtrip")
+                            "plandrift", "pmbundle", "wdogtrip",
+                            # compile telemetry (observability/compilemon):
+                            # more backend compiles / compile milliseconds
+                            # per round means shape churn is eating the
+                            # resident session's amortization win.  The
+                            # calibration tags (tools_profile_fit.py):
+                            # growing fit residuals or stale-constant
+                            # counts mean the profile is losing contact
+                            # with the hardware.
+                            "ncompile", "compilems", "compile_ms",
+                            "recompile_storms", "fit_residual",
+                            "stale_constants")
 # bookkeeping fields that are not measurements at all
-_SKIP = {"n", "rc", "probe_attempts", "wait_budget_s"}
+_SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
+         "schema_version"}
 
 
 def higher_is_better(tag: str) -> bool:

@@ -97,6 +97,12 @@ PLANDRIFT = "PLANDRIFT"    # gauge: |actual - predicted| JTOTAL as a percent of
                            # plan-vs-actual closed-loop signal; lower is better
 WDOGTRIP = "WDOGTRIP"      # hang-watchdog trips (observability/watchdog.py)
 PMBUNDLE = "PMBUNDLE"      # forensics bundles written (observability/postmortem)
+NCOMPILE = "NCOMPILE"      # backend compiles observed via jax.monitoring
+                           # (observability/compilemon.py); a resident serve
+                           # session recompiling after warmup is a storm
+COMPILEMS = "COMPILEMS"    # total backend-compile wall milliseconds (the
+                           # counter twin of the JCOMPILE bracket: hears
+                           # every compile, not just the bracketed one)
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

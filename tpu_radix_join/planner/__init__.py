@@ -18,19 +18,30 @@ existed only as prose.  Here it lives in code:
     the human-readable ``--explain`` table;
   * :mod:`cache`      — atomic on-disk plan + converged-window-capacity
     cache (the robustness checkpoint fingerprint discipline) so warm
-    starts skip both planning and the engine's sizing pre-pass.
+    starts skip both planning and the engine's sizing pre-pass;
+  * :mod:`calibrate`  — the self-calibration loop: robust-fits each
+    profile constant from cross-run ledger evidence
+    (observability/ledger.py), attributes persistent PLANDRIFT to the
+    constant behind the drifting cost term, and emits schema-v3 profiles
+    whose provenance blocks cite run ids, sample counts, CIs, and
+    freshness (``tools_profile_fit.py``, ``--profile auto``).
 """
 
 from tpu_radix_join.planner.audit import (actuals_for_explain, audit_plan,
                                           phase_snapshot)
 from tpu_radix_join.planner.cache import PlanCache
+from tpu_radix_join.planner.calibrate import (UnderSampledError, detect_stale,
+                                              diff_profiles, fit_profile)
 from tpu_radix_join.planner.cost_model import StrategyCost, Workload
 from tpu_radix_join.planner.plan import JoinPlan, explain_table, plan_join
 from tpu_radix_join.planner.profile import (DeviceProfile, calibrate,
-                                            load_profile)
+                                            format_provenance, load_profile,
+                                            resolve_profile)
 
 __all__ = [
-    "DeviceProfile", "JoinPlan", "PlanCache", "StrategyCost", "Workload",
-    "actuals_for_explain", "audit_plan", "calibrate", "explain_table",
-    "load_profile", "phase_snapshot", "plan_join",
+    "DeviceProfile", "JoinPlan", "PlanCache", "StrategyCost",
+    "UnderSampledError", "Workload", "actuals_for_explain", "audit_plan",
+    "calibrate", "detect_stale", "diff_profiles", "explain_table",
+    "fit_profile", "format_provenance", "load_profile", "phase_snapshot",
+    "plan_join", "resolve_profile",
 ]

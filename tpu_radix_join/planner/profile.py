@@ -32,7 +32,13 @@ from typing import Dict, Optional
 # wire-time term consumes (cost_model.plan_exchange).  v1 profiles load
 # through a shim that derives it from the cited ``ici_gbps`` (see
 # load_profile), so old files keep working without edits.
-SCHEMA_VERSION = 2
+# v3 adds per-constant *provenance*: a constant entry may carry a
+# ``"provenance"`` dict next to its value/source — fit origin, ledger run
+# ids, sample count, 95% confidence interval, fit residual, and a
+# freshness timestamp (planner/calibrate.py writes these).  v1/v2 files
+# load unchanged (provenance is additive; absent means "committed
+# snapshot, citation in the source tag").
+SCHEMA_VERSION = 3
 
 #: Constants the cost model reads.  Adding a term to cost_model.py means
 #: adding its constant here AND to every shipped profile, with a source tag
@@ -111,6 +117,24 @@ class DeviceProfile:
     def source(self, key: str) -> str:
         return str(self.constants[key]["source"])
 
+    def provenance(self, key: str) -> Optional[dict]:
+        """The schema-v3 provenance block of one constant (run ids, sample
+        count, CI, residual, freshness), or None for a committed/v1/v2
+        entry that carries only its citation string."""
+        entry = self.constants.get(key) or {}
+        prov = entry.get("provenance")
+        return dict(prov) if isinstance(prov, dict) else None
+
+    def freshness(self) -> Optional[float]:
+        """Newest ``fitted_at_epoch_s`` across the constants' provenance
+        blocks — what ``--profile auto`` compares against its freshness
+        window.  None when no constant was ever fitted."""
+        stamps = [p["fitted_at_epoch_s"]
+                  for p in (self.provenance(k) for k in self.constants)
+                  if p and isinstance(p.get("fitted_at_epoch_s"),
+                                      (int, float))]
+        return max(stamps) if stamps else None
+
     def fingerprint(self) -> dict:
         """Stable identity for cache keys / multi-host manifests: a plan or
         capacity cached under one profile must never warm-start a run under
@@ -184,6 +208,99 @@ def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
             notes=doc.get("notes", ""))
     except KeyError as e:
         raise ProfileError(f"profile {path} missing field {e}") from e
+
+
+#: filename the fitter writes next to a ledger; what ``--profile auto``
+#: prefers over the committed snapshot while it is fresh
+FITTED_PROFILE_BASENAME = "profile_fitted.json"
+DEFAULT_PROFILE = "v5e_lite"
+
+#: how old a fitted profile may be before ``auto`` falls back to the
+#: committed snapshot (override: TPU_RADIX_PROFILE_FRESH_S)
+DEFAULT_FRESH_S = 30 * 86400.0
+
+
+def resolve_profile(spec: str, ledger_dir: Optional[str] = None,
+                    fresh_s: Optional[float] = None) -> str:
+    """Resolve the driver's ``--profile`` value.  Anything but ``auto``
+    passes through.  ``auto`` prefers ``<ledger_dir>/profile_fitted.json``
+    (planner/calibrate.py output) when it loads AND its newest fit is
+    within the freshness window; otherwise the committed snapshot.  The
+    decision is returned as a loadable name-or-path — callers print it so
+    a run's profile choice is never silent."""
+    if spec != "auto":
+        return spec
+    if ledger_dir is None:
+        from tpu_radix_join.observability.ledger import default_ledger_dir
+        ledger_dir = default_ledger_dir()
+    if fresh_s is None:
+        fresh_s = float(os.environ.get("TPU_RADIX_PROFILE_FRESH_S",
+                                       DEFAULT_FRESH_S))
+    candidate = os.path.join(ledger_dir, FITTED_PROFILE_BASENAME)
+    if os.path.exists(candidate):
+        try:
+            fitted_at = load_profile(candidate).freshness()
+        except ProfileError:
+            return DEFAULT_PROFILE     # an unloadable fit never wins
+        if fitted_at is not None and time.time() - fitted_at <= fresh_s:
+            return candidate
+    return DEFAULT_PROFILE
+
+
+def format_provenance(profile: DeviceProfile,
+                      stale: Optional[dict] = None,
+                      now_s: Optional[float] = None) -> str:
+    """Per-constant provenance/staleness table — the constants half of the
+    ``--plan explain`` output.  ``stale`` is planner/calibrate.py's
+    ``detect_stale`` result (or any mapping/iterable of constant names);
+    a flagged constant's row says STALE and names the drift that
+    indicted it."""
+    stale = stale or {}
+    now_s = time.time() if now_s is None else now_s
+    header = ["constant", "value", "origin", "n", "ci95", "residual",
+              "age_h", "stale", "runs"]
+    rows = []
+    for key in sorted(profile.constants):
+        prov = profile.provenance(key) or {}
+        origin = (prov.get("origin")
+                  or profile.source(key).split(":", 1)[0] or "committed")
+        n = prov.get("n")
+        ci = prov.get("ci95")
+        resid = prov.get("residual")
+        ts = prov.get("fitted_at_epoch_s")
+        runs = prov.get("runs") or []
+        runs_cell = ",".join(str(r) for r in runs)
+        if len(runs_cell) > 40:
+            runs_cell = runs_cell[:37] + "..."
+        cell = ""
+        if key in stale:
+            info = stale[key] if isinstance(stale, dict) else None
+            cell = "STALE"
+            if isinstance(info, dict) and info.get("mean_drift_pct"):
+                cell += f" ({info['mean_drift_pct']:.0f}% drift)"
+        rows.append([
+            key, f"{profile.value(key):g}", str(origin),
+            str(n) if n else "-",
+            (f"[{ci[0]:g}, {ci[1]:g}]"
+             if isinstance(ci, (list, tuple)) and len(ci) == 2 else "-"),
+            f"{resid:.3f}" if isinstance(resid, (int, float)) else "-",
+            (f"{(now_s - ts) / 3600:.1f}"
+             if isinstance(ts, (int, float)) else "-"),
+            cell, runs_cell])
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows))
+              for i in range(len(header))]
+    fmt = lambda cells: "| " + " | ".join(
+        c.ljust(widths[i]) for i, c in enumerate(cells)) + " |"
+    lines = [f"profile {profile.name} (schema v{profile.schema_version}) "
+             f"constants — provenance/staleness:",
+             fmt(header),
+             "|" + "|".join("-" * (w + 2) for w in widths) + "|"]
+    lines += [fmt(r) for r in rows]
+    flagged = [k for k in sorted(profile.constants) if k in stale]
+    if flagged:
+        lines.append(f"stale: {', '.join(flagged)} — re-fit with "
+                     f"tools_profile_fit.py refresh")
+    return "\n".join(lines)
 
 
 def sort_stage_units(elems: int) -> float:

@@ -40,11 +40,13 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import sys
 import time
 from typing import Callable, Dict, List, Optional
 
 from tpu_radix_join.core.config import JoinConfig, ServiceConfig
-from tpu_radix_join.performance.measurements import (JHIST, QDEADLINE,
+from tpu_radix_join.performance.measurements import (COMPILEMS, JHIST,
+                                                     NCOMPILE, QDEADLINE,
                                                      QDEGRADED, QWARM)
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness.retry import (BACKEND_UNAVAILABLE,
@@ -134,12 +136,18 @@ class JoinSession:
                  service: Optional[ServiceConfig] = None,
                  measurements=None, plan_cache=None, profile: str = "v5e_lite",
                  clock: Callable[[], float] = time.monotonic,
-                 forensics_dir: Optional[str] = None):
+                 forensics_dir: Optional[str] = None,
+                 ledger=None):
         from tpu_radix_join.operators.hash_join import HashJoin
 
         self.config = config
         self.service = service or ServiceConfig()
         self.measurements = measurements
+        #: cross-run telemetry ledger (observability/ledger.py): when set,
+        #: every executed query appends one ``kind="query"`` row — the
+        #: per-query evidence stream a one-shot driver can't produce
+        self.ledger = ledger
+        self._recompile_storms = 0
         #: when set, every executed-and-failed query (deadline expiry,
         #: backend outage, breaker trip, corruption) drops a forensics
         #: bundle here (observability/postmortem.py), stamped with the
@@ -286,6 +294,8 @@ class JoinSession:
         engine = self.engine if primary else self._degraded_engine()
         t0 = time.perf_counter()
         jhist0 = m.times_us.get(JHIST, 0.0) if m is not None else 0.0
+        nc0 = m.counters.get(NCOMPILE, 0) if m is not None else 0
+        completed_before = self.slo.completed
         span = (m.span("query", query_id=request.query_id,
                        tenant=request.tenant,
                        engine="primary" if primary else "cpu_fallback",
@@ -370,6 +380,21 @@ class JoinSession:
                       else ("deadline_exceeded" if cls == DEADLINE_EXCEEDED
                             else "query_failed"))
             bundle = self._write_bundle(request, reason, cls, detail)
+        # recompile-storm canary: NCOMPILE rising after the session has
+        # completed queries means XLA is recompiling warm shapes — the
+        # amortization win a resident session exists for is leaking
+        nc_delta = (m.counters.get(NCOMPILE, 0) - nc0) if m is not None else 0
+        if nc_delta and completed_before > 0:
+            self._recompile_storms += 1
+            if m is not None:
+                m.event("recompile_storm", query_id=request.query_id,
+                        ncompile_delta=nc_delta,
+                        completed=completed_before)
+            if self._recompile_storms <= 3:      # warn loudly, don't spam
+                print(f"[OBS] recompile storm: query {request.query_id} "
+                      f"triggered {nc_delta} backend compile(s) after "
+                      f"{completed_before} completed queries",
+                      file=sys.stderr)
         if m is not None:
             m.flightrec.clear_context("query_id", "tenant")
         out = QueryOutcome(
@@ -384,6 +409,21 @@ class JoinSession:
                         failure_class=None if cls == OK else cls,
                         degraded=not primary)
         self.outcomes.append(out)
+        if self.ledger is not None:
+            # one ledger row per executed query; a ledger write failure is
+            # an event, never a new failure for the query
+            try:
+                self.ledger.append("query", {
+                    "query_id": request.query_id, "tenant": request.tenant,
+                    "status": status, "failure_class": cls,
+                    "latency_ms": round(latency_ms, 3),
+                    "warm": warm, "engine": out.engine,
+                    "tuples_per_node": request.tuples_per_node,
+                    "repeats": request.repeats,
+                    "ncompile": nc_delta or None})
+            except Exception as e:   # noqa: BLE001 — isolation boundary
+                if m is not None:
+                    m.event("ledger_error", error=repr(e)[:200])
         return out
 
     def _write_bundle(self, request: QueryRequest, reason: str,
@@ -433,6 +473,9 @@ class JoinSession:
         if m is not None:
             out["warm_queries"] = int(m.counters.get(QWARM, 0))
             out["degraded_queries"] = int(m.counters.get(QDEGRADED, 0))
+            out["ncompile"] = int(m.counters.get(NCOMPILE, 0))
+            out["compile_ms"] = int(m.counters.get(COMPILEMS, 0))
+            out["recompile_storms"] = self._recompile_storms
         return out
 
     def close(self) -> None:
