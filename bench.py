@@ -427,6 +427,103 @@ def _run_exchange_bench(check_baseline=None):
     return 0
 
 
+def _run_partition_bench(check_baseline=None, size=1 << 24):
+    """``--partition-bench``: A/B of the destination-grouping engine —
+    the sort-based block scatter (``sort_kv_unstable`` over every lane)
+    versus the fused Pallas histogram→scan→scatter partition kernel
+    (ops/pallas/partition.py, interpreted on this host mesh) — at ``size``
+    keys over 8 destination blocks.
+
+    Correctness first: two full 8-way host-CPU joins (one per impl) with
+    ``verify=check`` must be oracle-exact (exit 3 otherwise) so the timing
+    legs can never bless a wrong kernel.  The BENCH headline ``value`` is
+    the wall speedup (sort over fused, higher is better); the per-arm
+    walls land as lower-is-better tags and ``partition_unit_ms`` is the
+    reduced ms/Mtuple/pass constant the profile fitter recovers
+    (planner/calibrate.py BENCH_PARTITION_METRIC)."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import jax
+    import jax.numpy as jnp
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.data.relation import Relation
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.operators.hash_join import HashJoin
+    from tpu_radix_join.ops.pallas.partition import partition_slots_pallas
+    from tpu_radix_join.ops.radix import scatter_to_blocks
+    from tpu_radix_join.performance import Measurements
+
+    nodes, per_node = 8, 1 << 15
+    inner = Relation(per_node * nodes, nodes, "unique", seed=31)
+    outer = Relation(per_node * nodes, nodes, "unique", seed=32)
+    expected = inner.expected_matches(outer)
+    for impl in ("sort", "pallas_interpret"):
+        meas = Measurements(node_id=0, num_nodes=nodes)
+        eng = HashJoin(JoinConfig(num_nodes=nodes, verify="check",
+                                  partition_impl=impl), measurements=meas)
+        res = eng.join(inner, outer)
+        if not res.ok:
+            print(f"ERROR: verification failed (partition_impl={impl}): "
+                  f"{res.failure}", file=sys.stderr)
+            sys.exit(3)
+        if expected is not None and res.matches != expected:
+            print(f"ERROR: matches {res.matches} != oracle {expected} "
+                  f"(partition_impl={impl})", file=sys.stderr)
+            sys.exit(3)
+        print(f"note: join oracle-exact (partition_impl={impl}, "
+              f"{per_node * nodes} tuples/side)", file=sys.stderr)
+
+    # timing legs: the isolated scatter at bench scale — the same
+    # (batch, dest) -> blocks transform both engines run inside shard_map,
+    # jitted standalone so the A/B measures the grouping discipline alone
+    n = size
+    cap = (n // nodes) * 3 // 2          # uniform dest + 1.5x slack
+    rng = np.random.default_rng(7)
+    dest = jnp.asarray(rng.integers(0, nodes, n, dtype=np.uint32))
+    batch = TupleBatch(key=jnp.asarray(
+        rng.integers(0, 1 << 31, n, dtype=np.uint32)),
+        rid=jnp.arange(n, dtype=jnp.uint32))
+
+    def arm(impl):
+        fn = jax.jit(lambda b, d: scatter_to_blocks(
+            b, d, nodes, cap, "inner", impl=impl)[0].key)
+        return _time_amortized(fn, (batch, dest), iters=2) * 1e3
+
+    sort_wall = arm("sort")
+    fused_wall = arm("pallas_interpret")
+    kernel_fn = jax.jit(lambda d: partition_slots_pallas(
+        d, num_groups=nodes, capacity=cap, interpret=True)[0])
+    kernel_wall = _time_amortized(kernel_fn, (dest,), iters=2) * 1e3
+    unit = kernel_wall / (2.0 * n / 1e6)
+    speedup = sort_wall / max(fused_wall, 1e-9)
+    print(f"note: {n} keys -> {nodes} blocks: sort {sort_wall:.0f} ms, "
+          f"fused {fused_wall:.0f} ms (kernel {kernel_wall:.0f} ms), "
+          f"speedup {speedup:.2f}x, unit {unit:.4f} ms/Mtuple/pass",
+          file=sys.stderr)
+
+    result = {
+        "metric": "partition_fused_speedup",
+        "value": round(speedup, 3),
+        "unit": "sort_over_fused_wall",
+        "vs_baseline": round(speedup, 3),
+        "size": n,
+        "num_blocks": nodes,
+        "partition_ms": round(fused_wall, 1),
+        "partition_kernel_ms": round(kernel_wall, 1),
+        "partition_sort_ms": round(sort_wall, 1),
+        "partition_unit_ms": round(unit, 4),
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
     """``--serve-bench [N]``: the resident-service amortization bench.  N
     queries stream through ONE JoinSession on host CPU; query 0 pays mesh
@@ -601,6 +698,11 @@ def main():
         # staging): CPU-sized like --grid-bench — it gates exchange bytes
         # and the live exchange footprint, not chip throughput
         sys.exit(_run_exchange_bench(check_baseline))
+    if "--partition-bench" in argv:
+        # destination-grouping A/B (ops/pallas/partition.py vs the sort
+        # scatter): CPU-sized like --grid-bench — it gates the fused
+        # partition kernel's speedup and unit constant, not chip throughput
+        sys.exit(_run_partition_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

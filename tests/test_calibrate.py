@@ -36,6 +36,19 @@ def _bench_row(unit_ms, size=1 << 24, rid="b0"):
             "value": union / (t_ms / 1e3), "size": size}
 
 
+def _partition_row(unit_ms, size=1 << 24, rid="p0"):
+    """A --partition-bench row whose fused-kernel wall encodes a known
+    ms/Mtuple/pass unit (the kernel makes two passes over the ids)."""
+    kernel_ms = unit_ms * 2.0 * size / 1e6
+    return {"kind": "bench", "run_id": rid,
+            "metric": "partition_fused_speedup", "value": 1.7,
+            "size": size,
+            "partition_kernel_ms": kernel_ms,
+            "partition_ms": kernel_ms * 1.6,
+            "partition_sort_ms": kernel_ms * 2.8,
+            "partition_unit_ms": unit_ms}
+
+
 def _drift_row(rid, drift_pct, term="shuffle", predicted_ms=40.0):
     return {"kind": "run", "run_id": rid,
             "plan_vs_actual": {"drift_pct": drift_pct,
@@ -79,6 +92,29 @@ def test_dispatch_and_ici_samples_from_run_rows():
     assert fits["ici_bytes_per_s"].value == pytest.approx(5e10)
 
 
+def test_partition_unit_recovered_within_ci():
+    truth = 0.09
+    rows = [_partition_row(truth * f, rid=f"p{i}")
+            for i, f in enumerate((0.98, 1.0, 1.03, 1.0, 0.99))]
+    prof, fits = fit_profile(rows, base=load_profile())
+    fit = fits["partition_pass_unit_ms"]
+    lo, hi = fit.ci95
+    assert lo <= truth <= hi
+    assert abs(fit.value - truth) / truth < 0.05
+    prov = prof.provenance("partition_pass_unit_ms")
+    assert prov["origin"] == "fit" and prov["n"] == 5
+    assert "p0" in prov["runs"]
+
+
+def test_partition_unit_falls_back_to_reduced_tag():
+    # a row missing the primary kernel wall still contributes through the
+    # pre-reduced partition_unit_ms tag
+    row = _partition_row(0.08, rid="p9")
+    del row["partition_kernel_ms"]
+    samples = collect_samples([row])
+    assert [s.value for s in samples["partition_pass_unit_ms"]] == [0.08]
+
+
 def test_obs_rows_feed_any_constant():
     rows = [{"kind": "obs", "run_id": f"o{i}", "constant": "hbm_gbps",
              "value": 100.0 + i} for i in range(3)]
@@ -108,7 +144,7 @@ def test_v3_profile_roundtrips_with_provenance(tmp_path):
     path = str(tmp_path / "p.json")
     prof.save(path)
     back = load_profile(path)
-    assert back.schema_version == 3
+    assert back.schema_version == 4
     prov = back.provenance("sort_stage_unit_ms")
     assert prov["origin"] == "fit" and prov["n"] == 2
     assert prov["runs"] == ["b0", "b1"]
@@ -119,19 +155,39 @@ def test_v3_profile_roundtrips_with_provenance(tmp_path):
     assert back.provenance("hbm_gbps")["origin"] == "committed"
 
 
-def test_v1_shim_and_v2_committed_still_load(tmp_path):
-    committed = load_profile("v5e_lite")          # the checked-in v2
-    assert committed.schema_version == 2
+def test_v1_shim_and_committed_still_load(tmp_path):
+    committed = load_profile("v5e_lite")          # the checked-in v4
+    assert committed.schema_version == 4
     assert committed.freshness() is None          # no provenance: never fit
     v1 = {"schema_version": 1, "name": "old",
           "constants": {k: dict(committed.constants[k])
                         for k in committed.constants
-                        if k != "ici_bytes_per_s"}}
+                        if k not in ("ici_bytes_per_s",
+                                     "partition_pass_unit_ms")}}
     path = str(tmp_path / "v1.json")
     with open(path, "w") as f:
         json.dump(v1, f)
     back = load_profile(path)
     assert back.value("ici_bytes_per_s") == committed.value("ici_gbps") * 1e9
+    # v4 shim: the partition pass unit derives from the cited bandwidth
+    assert back.value("partition_pass_unit_ms") == pytest.approx(
+        8.0 / committed.value("hbm_gbps"), rel=1e-3)
+    assert back.source("partition_pass_unit_ms").startswith("shim:")
+
+
+def test_v3_profile_shims_partition_unit(tmp_path):
+    committed = load_profile("v5e_lite")
+    v3 = {"schema_version": 3, "name": "old3",
+          "constants": {k: dict(committed.constants[k])
+                        for k in committed.constants
+                        if k != "partition_pass_unit_ms"}}
+    path = str(tmp_path / "v3.json")
+    with open(path, "w") as f:
+        json.dump(v3, f)
+    back = load_profile(path)
+    assert back.value("partition_pass_unit_ms") == pytest.approx(
+        8.0 / committed.value("hbm_gbps"), rel=1e-3)
+    assert "schema v3" in back.source("partition_pass_unit_ms")
 
 
 def test_fingerprint_ignores_provenance():
@@ -203,9 +259,9 @@ def test_profile_fit_cli_fit_and_diff(tmp_path):
         led.append("bench", _bench_row(0.3, rid=f"b{i}"))
     out = _cli("tools_profile_fit.py", "fit", "--ledger", str(tmp_path))
     assert out.returncode == 0, out.stderr
-    assert "fitted 1/9 constants" in out.stdout
+    assert "fitted 1/10 constants" in out.stdout
     fitted = str(tmp_path / FITTED_PROFILE_BASENAME)
-    assert load_profile(fitted).schema_version == 3
+    assert load_profile(fitted).schema_version == 4
     # 0.3 vs committed 0.147 is > 25% -> diff gates
     out = _cli("tools_profile_fit.py", "diff", "v5e_lite", fitted)
     assert out.returncode == 1

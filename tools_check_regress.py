@@ -45,6 +45,21 @@ observability/compilemon.py) regress when a round starts recompiling
 warm shapes; ``fit_residual`` and ``stale_constants``
 (tools_profile_fit.py) regress when the fitted profile's spread grows or
 more constants drift away from the clock.
+
+A ``--partition-bench`` BENCH json gates the destination-grouping A/B
+(ops/pallas/partition.py fused kernel vs the sort-based scatter):
+
+    {"metric": "partition_fused_speedup", "value": 1.71, "size": 16777216,
+     "num_blocks": 8, "partition_ms": 4757.0, "partition_kernel_ms": 2873.0,
+     "partition_sort_ms": 8121.0, "partition_unit_ms": 0.0856}
+
+The headline ``value`` is the wall speedup (sort arm over fused arm,
+higher is better); ``partition_ms`` / ``partition_kernel_ms`` /
+``partition_sort_ms`` are walls and ``partition_unit_ms`` is the reduced
+ms/Mtuple/pass constant the profile fitter recovers — all pinned
+lower-is-better, alongside the ``PARTFALLBACK`` counter (silent degrades
+to the XLA sort path; on a TPU backend more of them means the fused
+kernel stopped being auto-selected).
 """
 
 import argparse

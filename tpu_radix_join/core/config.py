@@ -100,6 +100,16 @@ class JoinConfig:
     # 1 = fused single collective; 0 = auto (engine/planner picks by block
     # size); k > 1 = exactly k stages.
     exchange_stages: int = 1
+    # Partition/reorder implementation (ops/radix scatter_to_blocks &
+    # friends):
+    #   "auto"   — fused Pallas partition kernel when the backend compiles
+    #              Mosaic and the fanout fits MAX_PARTITIONS, else the
+    #              XLA sort path (the fallback ticks PARTFALLBACK).
+    #   "sort"   — force the XLA sort-based scatter (the pre-kernel path).
+    #   "pallas" / "pallas_interpret" — force the fused kernel (interpret
+    #              runs it through the Pallas interpreter: CPU tier-1
+    #              parity tests and host-mesh benches).
+    partition_impl: str = "auto"
 
     # --- policies --------------------------------------------------------------
     assignment_policy: str = "round_robin"   # or "load_aware"
@@ -206,6 +216,11 @@ class JoinConfig:
             raise ValueError(
                 "exchange_stages must be >= 0 (0 = auto, 1 = fused, "
                 "k > 1 = staged)")
+        if self.partition_impl not in (
+                "auto", "sort", "pallas", "pallas_interpret"):
+            raise ValueError(
+                f"unknown partition impl {self.partition_impl!r} (expected "
+                "'auto', 'sort', 'pallas', or 'pallas_interpret')")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if self.fallback not in ("none", "chunked"):

@@ -79,6 +79,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "memory to ~1/K.  1 = fused single collective, "
                         "0 = auto (stage 4-ways once blocks are >= 4096 "
                         "slots)")
+    p.add_argument("--partition-impl",
+                   choices=["auto", "sort", "pallas", "pallas_interpret"],
+                   default="auto",
+                   help="partition/reorder implementation (ops/radix.py): "
+                        "'auto' takes the fused Pallas histogram-scan-"
+                        "scatter kernel when the backend compiles Mosaic "
+                        "and the fanout fits, else the XLA sort path "
+                        "(fallback ticks PARTFALLBACK and logs once); "
+                        "'sort' forces the sort-based scatter; 'pallas"
+                        "_interpret' runs the kernel interpreted (CPU "
+                        "parity/bench)")
     p.add_argument("--cpu-fallback", action="store_true",
                    help="if device/mesh init fails, rebuild the engine over "
                         "host CPU devices (loud [DEGRADE] warning) instead "
@@ -552,6 +563,7 @@ def main(argv=None) -> int:
         verify=args.verify,
         exchange_codec=args.exchange_codec,
         exchange_stages=args.exchange_stages,
+        partition_impl=args.partition_impl,
     )
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)

@@ -67,7 +67,17 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # with the hardware.
                             "ncompile", "compilems", "compile_ms",
                             "recompile_storms", "fit_residual",
-                            "stale_constants")
+                            "stale_constants",
+                            # partition A/B tags (--partition-bench): both
+                            # arms' walls and the reduced kernel unit are
+                            # times (the headline speedup rides the
+                            # "speedup" substring above); PARTFALLBACK
+                            # counts silent degrades to the XLA sort path —
+                            # on a TPU backend more of them means the fused
+                            # kernel stopped being selected
+                            "partition_ms", "partition_kernel_ms",
+                            "partition_sort_ms", "partition_unit_ms",
+                            "partfallback")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          "schema_version"}

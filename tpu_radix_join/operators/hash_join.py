@@ -66,7 +66,8 @@ from tpu_radix_join.ops.merge_count import (
 )
 from tpu_radix_join.operators import skew
 from tpu_radix_join.operators.local_partitioning import local_partition
-from tpu_radix_join.ops.radix import local_histogram, scatter_to_blocks
+from tpu_radix_join.ops.radix import (local_histogram, scatter_to_blocks,
+                                      install_partition_observer)
 from tpu_radix_join.parallel.mesh import make_hierarchical_mesh, make_mesh
 from tpu_radix_join.parallel.network_partitioning import (network_partition,
                                                           receive_checksums)
@@ -145,6 +146,11 @@ class HashJoin:
                 f"{config.num_nodes}")
         self._compiled = {}
         self.measurements = measurements   # performance.Measurements or None
+        # trace-time partition telemetry (PARTPASS spans, PARTFALLBACK):
+        # ops/radix has no registry handle of its own, so the operator
+        # donates this one for the lifetime of the process
+        if measurements is not None:
+            install_partition_observer(measurements)
         # cooperative cancellation hook (service/deadline.py): an optional
         # ``callable(phase: str)`` consulted between pipeline phases; it
         # raises (e.g. DeadlineExceeded) to cancel the query between
@@ -866,9 +872,11 @@ class HashJoin:
             rp_batch, rp_valid = self._concat_hot_valid(rp_batch, rp_valid,
                                                         hot_batch)
             lr = local_partition(rp_batch, rp_valid, fanout,
-                                 cfg.local_fanout_bits, lcap_r, "inner")
+                                 cfg.local_fanout_bits, lcap_r, "inner",
+                                 impl=cfg.partition_impl)
             ls = local_partition(sp_batch, sp_valid, fanout,
-                                 cfg.local_fanout_bits, lcap_s, "outer")
+                                 cfg.local_fanout_bits, lcap_s, "outer",
+                                 impl=cfg.partition_impl)
             ovf = jax.lax.psum(
                 (lr.overflow + ls.overflow).astype(jnp.uint32), ax)
             return lr.blocks, ls.blocks, ovf
@@ -1014,9 +1022,11 @@ class HashJoin:
             rp_batch, rp_valid = self._concat_hot_valid(rp_batch, rp_valid,
                                                         hot_batch)
             lr = local_partition(rp_batch, rp_valid, fanout,
-                                 cfg.local_fanout_bits, lcap_r, "inner")
+                                 cfg.local_fanout_bits, lcap_r, "inner",
+                                 impl=cfg.partition_impl)
             ls = local_partition(sp_batch, sp_valid, fanout,
-                                 cfg.local_fanout_bits, lcap_s, "outer")
+                                 cfg.local_fanout_bits, lcap_s, "outer",
+                                 impl=cfg.partition_impl)
             counts, count_risk = self._bucket_probe(
                 lr.blocks, ls.blocks, lcap_r, lcap_s)
             sort_checks = None
@@ -1506,7 +1516,8 @@ class HashJoin:
             codec, _ = self._wire_side(cap, rid_bound)
             return Window(n, cap, ax, side, codec=codec, mode=mode,
                           fanout_bits=cfg.network_fanout_bits,
-                          key_bound=key_bound, rid_bound=rid_bound)
+                          key_bound=key_bound, rid_bound=rid_bound,
+                          partition_impl=cfg.partition_impl)
 
         return one(cap_r, "inner", rid_r), one(cap_s, "outer", rid_s)
 

@@ -54,11 +54,12 @@ def local_partition(
     local_fanout_bits: int,
     capacity: int,
     side: str,
+    impl: str | None = None,
 ) -> LocalPartitionResult:
     num_buckets = 1 << local_fanout_bits
     lpid = local_bucket_ids(batch, network_fanout_bits, local_fanout_bits)
     blocks, counts, overflow = scatter_to_blocks(
-        batch, lpid, num_buckets, capacity, side, valid=valid)
+        batch, lpid, num_buckets, capacity, side, valid=valid, impl=impl)
     # counts IS the per-bucket histogram: scatter_to_blocks derives it from
     # run boundaries of the same (valid-masked) bucket ids, so a separate
     # histogram pass over the tuples would recompute it byte-for-byte

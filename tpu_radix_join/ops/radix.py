@@ -14,6 +14,8 @@ pass), i.e. the TPU equivalents of the GPU ``histogram_build_L1/L2`` +
 
 from __future__ import annotations
 
+import sys
+from contextlib import nullcontext
 from typing import Tuple
 
 import jax
@@ -21,6 +23,80 @@ import jax.numpy as jnp
 
 from tpu_radix_join.data.tuples import CompressedBatch, make_padding_like
 from tpu_radix_join.ops.sorting import sort_kv_unstable
+from tpu_radix_join.performance.measurements import PARTFALLBACK, PARTPASS
+
+
+# ------------------------------------------------------------- impl selection
+#
+# Partition-impl auto-selection happens at TRACE time (these functions run
+# inside jit/shard_map bodies, where no host counter can tick per
+# execution), so the observability hook lives at module level: the engine
+# registers its Measurements once (HashJoin.__init__) and every traced
+# scatter/reorder site records which path it took — PARTPASS for the fused
+# Pallas kernel, PARTFALLBACK when auto degrades to the XLA sort path.
+_partition_observer: dict = {"meas": None}
+_fallback_logged = False
+
+
+def install_partition_observer(measurements) -> None:
+    """Register a performance.Measurements (or None) to receive PARTPASS /
+    PARTFALLBACK ticks and partition spans from trace-time impl selection.
+    Process-global: the most recent engine wins, which is the engine whose
+    programs are being traced."""
+    _partition_observer["meas"] = measurements
+
+
+def _partition_span(impl: str, site: str, num_partitions: int):
+    """Span bracketing the trace-time construction of one fused partition
+    op — mirrored into the flight recorder ring like every span."""
+    m = _partition_observer["meas"]
+    if m is None:
+        return nullcontext()
+    m.incr(PARTPASS)
+    return m.span("partition_pass", impl=impl, site=site,
+                  num_partitions=num_partitions)
+
+
+def _note_fallback(site: str, num_partitions: int, why: str) -> None:
+    """Auto-select degraded to the XLA sort path: tick the counter and log
+    once per process instead of staying silent (a TPU run quietly paying
+    the sort where the fused kernel was expected is a perf bug)."""
+    global _fallback_logged
+    m = _partition_observer["meas"]
+    if m is not None:
+        m.incr(PARTFALLBACK)
+    if not _fallback_logged:
+        _fallback_logged = True
+        print(f"[radix] partition auto-select fell back to the XLA sort "
+              f"path at {site} (num_partitions={num_partitions}: {why}); "
+              f"further fallbacks tick PARTFALLBACK silently",
+              file=sys.stderr)
+
+
+def resolve_partition_impl(impl: str | None, num_partitions: int,
+                           site: str) -> str:
+    """Resolve a partition ``impl`` request to a concrete path.
+
+    ``None``/"auto" prefers the fused Pallas kernel when the backend has
+    one and the fanout fits its unrolled loop, else falls back to the
+    sort-based path ("loop") with PARTFALLBACK visibility.  "sort" is an
+    explicit alias for the default sort discipline; "loop"/"gather" name
+    its two fill disciplines; "pallas"/"pallas_interpret" force the fused
+    kernel (interpret = traced JAX ops, the tier-1 CPU parity path)."""
+    from tpu_radix_join.ops.pallas.partition import (
+        MAX_PARTITIONS, pallas_partition_available)
+    if impl in (None, "auto"):
+        if not pallas_partition_available():
+            _note_fallback(site, num_partitions, "Pallas unavailable")
+            return "loop"
+        if num_partitions > MAX_PARTITIONS:
+            _note_fallback(site, num_partitions,
+                           f"> MAX_PARTITIONS {MAX_PARTITIONS}")
+            return "loop"
+        return "pallas"
+    if impl == "sort":
+        return "loop"
+    return impl
 
 
 def local_histogram(pid: jnp.ndarray, num_partitions: int,
@@ -40,8 +116,15 @@ def local_histogram(pid: jnp.ndarray, num_partitions: int,
     from tpu_radix_join.ops.pallas.histogram import (
         MAX_PARTITIONS, histogram_pallas, pallas_histogram_available)
     if impl is None:
-        impl = "pallas" if (pallas_histogram_available()
-                            and num_partitions <= MAX_PARTITIONS) else "xla"
+        if (pallas_histogram_available()
+                and num_partitions <= MAX_PARTITIONS):
+            impl = "pallas"
+        else:
+            impl = "xla"
+            _note_fallback("local_histogram", num_partitions,
+                           f"> MAX_PARTITIONS {MAX_PARTITIONS}"
+                           if pallas_histogram_available()
+                           else "Pallas unavailable")
     weights = None if valid is None else valid.astype(jnp.uint32)
     if impl == "xla":
         hist = jnp.bincount(pid.astype(jnp.int32), weights=weights,
@@ -61,22 +144,52 @@ def exclusive_cumsum(hist: jnp.ndarray) -> jnp.ndarray:
 def reorder_by_partition(
     batch: CompressedBatch, pid: jnp.ndarray, num_partitions: int,
     valid: jnp.ndarray | None = None,
+    impl: str | None = None,
 ) -> Tuple[CompressedBatch, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Reorder so each partition's tuples are contiguous (order *within* a
     partition is unspecified — every consumer re-sorts or is order-free).
 
     Returns (reordered batch, reordered pid, histogram, base offsets).  Invalid
     (padding) slots are routed to a virtual partition after all real ones so
-    they land at the tail.  The reorder itself is ``argsort`` on the partition
-    id — XLA lowers this to a parallel sort, the TPU replacement for the SWWC
-    scatter loop (see module docstring).
+    they land at the tail.
+
+    ``impl`` (resolve_partition_impl): the fused Pallas kernel assigns every
+    tuple its dense destination in two streaming passes over the ids and the
+    lanes move in one unique-index scatter; the sort fallback is ``argsort``
+    on the partition id — XLA lowers this to a parallel sort, the TPU
+    replacement for the SWWC scatter loop (see module docstring) — with the
+    histogram derived from ``searchsorted`` run bounds over the sorted keys
+    (one fewer HBM pass than a separate ``local_histogram``).
     """
     sort_key = pid.astype(jnp.uint32)
     if valid is not None:
         sort_key = jnp.where(valid, sort_key, jnp.uint32(num_partitions))
+    impl = resolve_partition_impl(impl, num_partitions, "reorder_by_partition")
+    if impl in ("pallas", "pallas_interpret"):
+        from tpu_radix_join.ops.pallas.partition import partition_slots_pallas
+        with _partition_span(impl, "reorder_by_partition", num_partitions):
+            # num_partitions + 1 dense groups: the virtual invalid partition
+            # is a REAL group here so every tuple lands (a permutation), with
+            # invalid rows contiguous at the tail exactly like the sort path
+            slots, hist_x = partition_slots_pallas(
+                sort_key, num_groups=num_partitions + 1, group_size=1,
+                capacity=None, interpret=(impl == "pallas_interpret"))
+        scatter = lambda x: (jnp.zeros_like(x) + x[0] * x.dtype.type(0)
+                             ).at[slots].set(x, mode="drop")
+        out = jax.tree.map(scatter, batch)
+        hist = hist_x[:num_partitions]
+        return out, scatter(pid), hist, exclusive_cumsum(hist)
     order = jnp.argsort(sort_key, stable=False)
     out = jax.tree.map(lambda x: x[order], batch)
-    hist = local_histogram(pid, num_partitions, valid)
+    # run bounds over the already-sorted keys replace the separate
+    # local_histogram pass: bounds[p] = #keys < p, so adjacent differences
+    # are exactly the per-partition counts with invalid rows (key ==
+    # num_partitions) excluded — byte-identical to the bincount, one fewer
+    # pass over the ids
+    bounds = jnp.searchsorted(
+        sort_key[order],
+        jnp.arange(num_partitions + 1, dtype=jnp.uint32)).astype(jnp.uint32)
+    hist = bounds[1:] - bounds[:-1]
     return out, pid[order], hist, exclusive_cumsum(hist)
 
 
@@ -87,7 +200,7 @@ def scatter_to_blocks(
     capacity: int,
     side: str,
     valid: jnp.ndarray | None = None,
-    impl: str = "loop",
+    impl: str | None = None,
 ):
     """Route tuples into ``num_blocks`` statically-sized blocks of ``capacity``
     slots, padding unused slots with the side's sentinel.
@@ -97,21 +210,28 @@ def scatter_to_blocks(
     (``Window.cpp:86-144``), XLA needs static shapes, so each destination gets
     a fixed-capacity block and a valid count (SURVEY.md §7.2).
 
-    ``impl`` selects how the sorted runs land in their blocks (both exact;
-    experiments/exp_block_scatter.py holds the on-chip measurements — the
-    reference has the same obsession with this inner loop's discipline,
-    NetworkPartitioning.cpp:224-260):
-      * "loop" (default): ``fori_loop`` of per-destination dynamic-slice
-        copies — one contiguous DMA per destination, but num_blocks
-        sequential steps.
-      * "gather": ONE vectorized row gather ``lane[starts[d]+j]`` over the
-        [num_blocks, capacity] grid — no sequential dependency.
+    ``impl`` (resolve_partition_impl; None = auto):
+      * "pallas" / "pallas_interpret": the fused histogram→scan→scatter
+        kernel (ops/pallas/partition.py) — slot assignment in two streaming
+        passes over the ids, then ONE unique-index scatter per lane; no sort.
+      * "sort"/"loop"/"gather": sort by destination, then place each run;
+        "loop" is a ``fori_loop`` of per-destination dynamic-slice copies
+        (one contiguous DMA per destination), "gather" ONE vectorized row
+        gather over the [num_blocks, capacity] grid
+        (experiments/exp_block_scatter.py holds the on-chip measurements —
+        the reference has the same obsession with this inner loop's
+        discipline, NetworkPartitioning.cpp:224-260).
 
     Returns (blocks batch with arrays shaped [num_blocks * capacity],
     counts uint32 [num_blocks] — the *unclipped* per-destination demand, and
     overflow uint32 — how many tuples did not fit; 0 in correct runs, checked
     by Window.assert_all_tuples_written).
     """
+    impl = resolve_partition_impl(impl, num_blocks, "scatter_to_blocks")
+    if impl in ("pallas", "pallas_interpret"):
+        blocks, counts, _, overflow = _scatter_blocks_fused(
+            batch, dest, None, num_blocks, 1, capacity, side, valid, impl)
+        return blocks, counts, overflow
     sort_key = dest.astype(jnp.uint32)
     if valid is not None:
         sort_key = jnp.where(valid, sort_key, jnp.uint32(num_blocks))
@@ -146,7 +266,7 @@ def scatter_to_blocks_grouped(
     capacity: int,
     side: str,
     valid: jnp.ndarray | None = None,
-    impl: str = "loop",
+    impl: str | None = None,
 ):
     """:func:`scatter_to_blocks` with a secondary ordering key: tuples within
     each destination block land sorted by ``sub`` (the partition id on the
@@ -165,6 +285,11 @@ def scatter_to_blocks_grouped(
     ``scatter_to_blocks``) and ``group_counts`` is uint32
     [num_blocks, num_sub], *clipped* to capacity so it sums to the tuples
     actually present in each block."""
+    impl = resolve_partition_impl(impl, num_blocks * num_sub,
+                                  "scatter_to_blocks_grouped")
+    if impl in ("pallas", "pallas_interpret"):
+        return _scatter_blocks_fused(batch, dest, sub, num_blocks, num_sub,
+                                     capacity, side, valid, impl)
     comp = dest.astype(jnp.uint32) * jnp.uint32(num_sub) + sub.astype(
         jnp.uint32)
     sort_key = comp
@@ -248,3 +373,50 @@ def _fill_blocks(batch, lanes, treedef, sorted_lanes, starts, counts,
     overflow = jnp.sum(
         jnp.maximum(counts, jnp.uint32(capacity)) - jnp.uint32(capacity))
     return blocks, overflow.astype(jnp.uint32)
+
+
+def _scatter_blocks_fused(batch, dest, sub, num_blocks, num_sub, capacity,
+                          side, valid, impl):
+    """Fused block fill: the Pallas kernel assigns slots + exact histogram
+    in two streaming passes over the (composite) ids, then each lane moves
+    in ONE unique-index scatter (``mode="drop"`` discards the overflow/
+    invalid sentinel rows).  Returns the 4-tuple shape of the grouped
+    entry; the flat entry drops the group_counts member.
+
+    Contract parity with the sort path: counts are the UNCLIPPED demand,
+    group_counts the clip that keeps the lowest pids (the kernel drops
+    exactly the tuples whose unclipped within-destination position passed
+    capacity, i.e. the highest-pid tail), overflow the same
+    sum(max(counts - capacity, 0)).  Within-block order is input order
+    grouped by pid — sorted by ``sub`` as pack_blocks requires."""
+    from tpu_radix_join.ops.pallas.partition import partition_slots_pallas
+    key = dest.astype(jnp.uint32)
+    if sub is not None:
+        key = key * jnp.uint32(num_sub) + sub.astype(jnp.uint32)
+    num_groups = num_blocks * num_sub
+    if valid is not None:
+        key = jnp.where(valid, key, jnp.uint32(num_groups))
+    with _partition_span(impl, "scatter_to_blocks", num_groups):
+        slots, ghist = partition_slots_pallas(
+            key, num_groups=num_groups, group_size=num_sub,
+            capacity=capacity, interpret=(impl == "pallas_interpret"))
+    lanes, treedef = jax.tree.flatten(batch)
+    pad_leaves = jax.tree.leaves(make_padding_like(batch, 1, side))
+    # init buffers carry the pad value everywhere (dropped/overflow slots
+    # stay sentinel-filled) and derive from the input lanes so their
+    # varying-manual-axes type matches inside shard_map bodies
+    masked = [
+        (jnp.zeros((num_blocks * capacity,), lane.dtype)
+         + lane[0] * lane.dtype.type(0) + pad[0]
+         ).at[slots].set(lane, mode="drop")
+        for lane, pad in zip(lanes, pad_leaves)
+    ]
+    blocks = jax.tree.unflatten(treedef, masked)
+    group_raw = ghist.reshape(num_blocks, num_sub)
+    counts = jnp.sum(group_raw, axis=1, dtype=jnp.uint32)
+    cum = jnp.minimum(jnp.cumsum(group_raw, axis=1), jnp.uint32(capacity))
+    group_counts = jnp.concatenate([cum[:, :1], cum[:, 1:] - cum[:, :-1]],
+                                   axis=1)
+    overflow = jnp.sum(
+        jnp.maximum(counts, jnp.uint32(capacity)) - jnp.uint32(capacity))
+    return blocks, counts, group_counts, overflow.astype(jnp.uint32)

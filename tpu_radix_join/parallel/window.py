@@ -193,7 +193,8 @@ class Window:
                  side: str, codec: str = "off", mode="fused",
                  fanout_bits: int = 0,
                  key_bound: Optional[int] = None,
-                 rid_bound: Optional[int] = None):
+                 rid_bound: Optional[int] = None,
+                 partition_impl: Optional[str] = None):
         if codec not in ("off", "pack"):
             raise ValueError(
                 f"window codec must be 'off' or 'pack', got {codec!r} "
@@ -207,6 +208,7 @@ class Window:
         self.fanout_bits = fanout_bits
         self.key_bound = key_bound
         self.rid_bound = rid_bound
+        self.partition_impl = partition_impl
 
     def wire_spec(self, wide: bool) -> WireSpec:
         """The packed-wire geometry for this window's bounds (static)."""
@@ -234,7 +236,8 @@ class Window:
                     "partition membership — pass pid= to exchange()")
             spec = self.wire_spec(wide=batch[2] is not None)
             blocks, counts, group_counts, overflow = scatter_to_blocks_grouped(
-                batch, dest, pid, n, spec.num_sub, c, self.side, valid=valid)
+                batch, dest, pid, n, spec.num_sub, c, self.side, valid=valid,
+                impl=self.partition_impl)
             words = pack_blocks(spec, blocks, group_counts)
             recv_words = block_all_to_all(words, n, spec.block_words,
                                           self.axis_name, mode=self.mode)
@@ -242,7 +245,8 @@ class Window:
                                                     self.side)
             return ExchangeResult(recv_batch, recv_counts, overflow)
         blocks, counts, overflow = scatter_to_blocks(
-            batch, dest, n, c, self.side, valid=valid)
+            batch, dest, n, c, self.side, valid=valid,
+            impl=self.partition_impl)
 
         received = jax.tree.map(
             lambda x: block_all_to_all(x, n, c, self.axis_name,

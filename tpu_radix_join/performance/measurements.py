@@ -103,6 +103,14 @@ NCOMPILE = "NCOMPILE"      # backend compiles observed via jax.monitoring
 COMPILEMS = "COMPILEMS"    # total backend-compile wall milliseconds (the
                            # counter twin of the JCOMPILE bracket: hears
                            # every compile, not just the bracketed one)
+PARTPASS = "PARTPASS"      # fused (pallas) radix-partition passes selected at
+                           # trace time (ops/radix.py); one per traced scatter/
+                           # reorder site, so a recompiling session ticks it
+                           # per program build, not per execution
+PARTFALLBACK = "PARTFALLBACK"  # partition/histogram auto-select fell back to
+                           # the XLA sort path (Pallas unavailable or fanout
+                           # past MAX_PARTITIONS) — the silent-degrade signal;
+                           # more of these on a TPU backend is a regression
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

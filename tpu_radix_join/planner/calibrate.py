@@ -22,6 +22,9 @@ Per-constant stage models (sample extraction):
     at that size the floor IS the wall time.
   * ``ici_bytes_per_s`` — run rows: WIREBYTES / JMPI is the achieved
     wire rate of the exchange the codec actually shipped.
+  * ``partition_pass_unit_ms`` — ``--partition-bench`` rows: the fused
+    arm's kernel wall inverts over two passes at the row's element count
+    (ops/pallas/partition.py makes exactly two streaming passes).
   * anything — ``kind="obs"`` rows carry a pre-reduced
     ``{"constant": ..., "value": ...}`` observation (the extension point
     for dedicated probes).
@@ -55,10 +58,19 @@ TERM_TO_CONSTANT = {
     "shuffle": "ici_bytes_per_s",
     "dispatch": "dispatch_floor_ms",
     "scatter": "scatter_loop_melems_s",
+    # destination grouping under plan_partition: the fused-pallas arm is
+    # priced by the partition pass unit; the sort arm folds into the same
+    # term (its drift still indicts the partition row in --plan explain)
+    "partition": "partition_pass_unit_ms",
 }
 
 #: the bench metric whose stage model we can invert for the sort unit
 BENCH_SORT_METRIC = "single_chip_join_throughput"
+
+#: --partition-bench A/B rows: the fused arm's wall and element count
+#: invert directly to ms per million tuples per pass (the kernel makes
+#: two passes, ops/pallas/partition.py)
+BENCH_PARTITION_METRIC = "partition_fused_speedup"
 
 #: runs at or below this global size are pure dispatch floor
 SMALL_RUN_ELEMS = 1 << 16
@@ -114,6 +126,24 @@ def _sort_unit_from_bench(row: dict) -> Optional[Sample]:
     return Sample(t_ms / units, str(row.get("run_id", "?")))
 
 
+def _partition_unit_from_bench(row: dict) -> Optional[Sample]:
+    """Invert a --partition-bench row to ms/Mtuple/pass: the fused arm's
+    kernel wall over two passes at the row's element count (the bench also
+    publishes the reduced ``partition_unit_ms`` tag; recomputing from the
+    primary measurement keeps the fit independent of the reduction)."""
+    if row.get("metric") != BENCH_PARTITION_METRIC:
+        return None
+    size = int(row.get("size") or 0)
+    kernel_ms = float(row.get("partition_kernel_ms") or 0.0)
+    rid = str(row.get("run_id", "?"))
+    if size > 0 and kernel_ms > 0:
+        return Sample(kernel_ms / (2.0 * size / 1e6), rid)
+    unit = float(row.get("partition_unit_ms") or 0.0)
+    if unit > 0:
+        return Sample(unit, rid)
+    return None
+
+
 def collect_samples(rows: List[dict]) -> Dict[str, List[Sample]]:
     """Constant -> samples, pooled across every row kind that carries
     evidence for it.  Rows that lack a given signal simply contribute
@@ -131,6 +161,9 @@ def collect_samples(rows: List[dict]) -> Dict[str, List[Sample]]:
             s = _sort_unit_from_bench(row)
             if s is not None:
                 out.setdefault("sort_stage_unit_ms", []).append(s)
+            s = _partition_unit_from_bench(row)
+            if s is not None:
+                out.setdefault("partition_pass_unit_ms", []).append(s)
         elif kind == "run":
             times = row.get("times_us") or {}
             counters = row.get("counters") or {}

@@ -153,6 +153,52 @@ def scatter_loop_ms(profile: DeviceProfile, elems: int) -> float:
     return elems / profile.value("scatter_loop_melems_s") / 1e6 * 1e3
 
 
+@dataclasses.dataclass(frozen=True)
+class PartitionPlan:
+    """The cost model's destination-grouping decision: fused Pallas
+    partition kernel vs the sort-backed scatter loop, with both arms'
+    prices kept for the explain table."""
+
+    impl: str               # "pallas" | "sort"
+    partition_ms: float     # the chosen arm
+    fused_ms: float         # two streaming kernel passes + the lane scatter
+    sort_ms: float          # the scatter-loop (sort-rate-bound) arm
+    note: str = ""
+
+
+def plan_partition(profile: DeviceProfile, elems: int,
+                   pallas_ok: Optional[bool] = None) -> PartitionPlan:
+    """Price both destination-grouping arms and pick the cheaper available.
+
+    The fused arm is the Pallas radix-partition kernel
+    (ops/pallas/partition.py): two streaming passes over the ids at
+    ``partition_pass_unit_ms`` each, after which every lane crosses HBM
+    once more through the collision-free slot scatter — priced as one
+    HBM pass over the lane bytes.  The sort arm is the block-scatter loop
+    discipline the engine falls back to (``scatter_loop_melems_s``).
+    ``pallas_ok=None`` probes the backend (ops/radix auto-select's own
+    rule); tests pass an explicit bool to price either arm portably.
+    """
+    if pallas_ok is None:
+        from tpu_radix_join.ops.pallas.partition import (
+            pallas_partition_available)
+        pallas_ok = pallas_partition_available()
+    fused = (profile.value("partition_pass_unit_ms") * elems / 1e6 * 2.0
+             + hbm_pass_ms(profile, elems * 2 * LANE_BYTES))
+    sort_arm = scatter_loop_ms(profile, elems)
+    if pallas_ok and fused <= sort_arm:
+        return PartitionPlan(
+            impl="pallas", partition_ms=fused, fused_ms=fused,
+            sort_ms=sort_arm,
+            note=(f"fused pallas partition {fused:.2f} ms vs "
+                  f"{sort_arm:.2f} ms scatter loop"))
+    return PartitionPlan(
+        impl="sort", partition_ms=sort_arm, fused_ms=fused,
+        sort_ms=sort_arm,
+        note=("pallas unavailable: scatter loop" if not pallas_ok else
+              f"scatter loop {sort_arm:.2f} ms beats fused {fused:.2f} ms"))
+
+
 def network_fanout_bits(w: Workload) -> int:
     """Network radix bits: at least enough partitions to cover the mesh,
     at most the default 32-way fanout, and never more partitions than
@@ -335,19 +381,22 @@ def enumerate_strategies(profile: DeviceProfile,
             note=(key_why or mem_note
                   or "pays one dispatch floor per split program"))
 
-    # two-level bucket discipline: the second radix pass is a scatter
-    # (itself sort-rate-bound on this hardware) + batched per-bucket sorts;
-    # always full-range by construction (no packed merge).
+    # two-level bucket discipline: the second radix pass groups tuples by
+    # destination bucket — priced by plan_partition as the cheaper of the
+    # fused Pallas partition kernel and the sort-rate-bound block-scatter
+    # loop (the pre-kernel path) — plus batched per-bucket sorts; always
+    # full-range by construction (no packed merge).
     nb = 32                                      # local fanout 5
+    pplan = plan_partition(profile, union)
     twolevel = {
-        "scatter": scatter_loop_ms(profile, union),
+        "partition": pplan.partition_ms,
         "sort": sort_ms(profile, union, 1.0, rows=nb),
         "scan": scan,
         **xch,
         "dispatch": amortized_dispatch(PROGRAMS["fused"]),
     }
     add("incore_fused_twolevel", fits, twolevel,
-        note=mem_note or "second radix pass rides the block-scatter loop")
+        note=mem_note or f"second radix pass: {pplan.note}")
 
     # chunked out-of-core grid: every (inner, outer) chunk pair probed
     # once; per-pair cost is a resident-sized sort + scan + one host

@@ -38,7 +38,12 @@ from typing import Dict, Optional
 # freshness timestamp (planner/calibrate.py writes these).  v1/v2 files
 # load unchanged (provenance is additive; absent means "committed
 # snapshot, citation in the source tag").
-SCHEMA_VERSION = 3
+# v4 adds ``partition_pass_unit_ms`` — ms per million tuples per streaming
+# pass of the fused Pallas radix-partition kernel (ops/pallas/partition.py;
+# the kernel makes two passes over the ids and the lanes cross HBM twice).
+# v1-v3 profiles load through a shim deriving it from the cited hbm_gbps
+# (8 B of ids traffic per tuple per pass at streaming bandwidth).
+SCHEMA_VERSION = 4
 
 #: Constants the cost model reads.  Adding a term to cost_model.py means
 #: adding its constant here AND to every shipped profile, with a source tag
@@ -69,6 +74,12 @@ REQUIRED_CONSTANTS = (
     # wire_bytes taken from the packed WireSpec, not a hardcoded 8 B/tuple).
     # Schema v2; v1 profiles are shimmed to ici_gbps * 1e9 at load.
     "ici_bytes_per_s",
+    # fused Pallas radix-partition kernel: ms per million tuples per
+    # streaming pass (the kernel is two passes over the ids; the cost model
+    # charges unit * Mtuples * 2).  Schema v4; v1-v3 profiles are shimmed
+    # to 8.0 / hbm_gbps at load (4 B read + 4 B written per tuple per pass
+    # at the profile's streaming bandwidth).
+    "partition_pass_unit_ms",
 )
 
 #: Reference element count of the sort stage model's unit (PERF_NOTES
@@ -201,6 +212,19 @@ def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
                     "value": float(entry["value"]) * 1e9,
                     "source": ("shim:derived from ici_gbps "
                                "(schema v1 profile; "
+                               f"{entry.get('source', 'uncited')})")}
+        if version < 4 and "partition_pass_unit_ms" not in constants:
+            # schema v1-v3 shim: the partition cost term (schema v4) reads
+            # partition_pass_unit_ms; derive it from the cited hbm_gbps —
+            # one kernel pass streams 4 B of ids in + 4 B of slots out per
+            # tuple, so at bandwidth B GB/s a million tuples cost 8e6/B ns
+            # = 8/B ms.
+            entry = constants.get("hbm_gbps")
+            if isinstance(entry, dict) and entry.get("value"):
+                constants["partition_pass_unit_ms"] = {
+                    "value": round(8.0 / float(entry["value"]), 5),
+                    "source": ("shim:derived from hbm_gbps "
+                               f"(schema v{version} profile; "
                                f"{entry.get('source', 'uncited')})")}
         return DeviceProfile(
             name=doc["name"], constants=constants,
