@@ -637,6 +637,129 @@ def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
     return 0
 
 
+def _run_recovery_bench(check_baseline=None, size=1 << 18):
+    """``--recovery-bench``: the elastic-recovery A/B — kill-1-of-8
+    partition-level recovery versus the cold full restart it replaces.
+
+    Both arms run an 8-way host-CPU mesh at ``size`` tuples per side with
+    the oracle-friendly chaos inputs (R a permutation of 1..n, S uniform,
+    true count exactly n).  The **restart arm** times a full warm join —
+    what a non-elastic job pays after ANY rank death.  The **recovery
+    arm** models the kill: a partition manifest holds the true counts of
+    every partition the dead rank did NOT own (realized pre-death), the
+    ``membership.rank_death`` site fires mid-join, and the elastic engine
+    resumes the manifest + recomputes only the dead rank's partitions
+    host-side.  Both arms are compile-warmed before timing.
+
+    Exit 3 unless the recovered count is oracle-exact AND the recompute
+    stayed partition-granular (``RECOVERN`` strictly below the partition
+    count).  The BENCH headline ``value`` is the wall ratio (cold restart
+    over recovery, higher is better); ``recover_ms``/``cold_restart_ms``/
+    ``recovern``/``ranklost``/``mepoch`` gate lower-is-better under
+    tools_check_regress.py."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import tempfile
+
+    import jax.numpy as jnp
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.operators.hash_join import HashJoin
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import (MEPOCH, RANKLOST,
+                                                         RECOVERN)
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.robustness.checkpoint import PartitionManifest
+
+    nodes, n = 8, size
+    cfg = JoinConfig(num_nodes=nodes, network_fanout_bits=4, verify="check")
+    num_p = cfg.network_partition_count
+    dead = nodes - 1                       # _rank_death's simulated victim
+    rng = np.random.default_rng(23)
+    rk = (rng.permutation(n) + 1).astype(np.uint32)
+    sk = rng.integers(1, n + 1, size=n).astype(np.uint32)
+    rid = np.arange(n, dtype=np.uint32)
+    r = TupleBatch(key=jnp.asarray(rk), rid=jnp.asarray(rid))
+    s = TupleBatch(key=jnp.asarray(sk), rid=jnp.asarray(rid))
+    # every S key matches exactly one R key, so a partition's true count
+    # is its S-key population — what the manifest would hold post-realize
+    true = np.bincount(sk & (num_p - 1), minlength=num_p)
+
+    # ---- restart arm: the full warm join a non-elastic job re-pays
+    eng = HashJoin(cfg, measurements=Measurements(num_nodes=nodes))
+    res = eng.join_arrays(r, s)            # compile warm-up
+    if not (res.ok and res.matches == n):
+        print(f"ERROR: baseline join missed the oracle: {res.matches} "
+              f"!= {n}", file=sys.stderr)
+        return 3
+    t0 = time.perf_counter()
+    eng.join_arrays(r, s)
+    cold_ms = (time.perf_counter() - t0) * 1e3
+
+    # ---- recovery arm: manifest resumes all but the dead rank's share
+    tmp = tempfile.mkdtemp(prefix="recovery_bench_")
+    eng.elastic = True
+
+    def one_recovery(tag):
+        man = PartitionManifest(os.path.join(tmp, f"m_{tag}.manifest"),
+                                fingerprint={"bench": "recovery"})
+        man.mark_many({p: int(true[p]) for p in range(num_p)
+                       if p % nodes != dead}, owner_of=lambda p: p % nodes)
+        m = Measurements(num_nodes=nodes)
+        eng.measurements = m
+        eng.partition_manifest = man
+        try:
+            with faults.FaultInjector(seed=5, measurements=m).arm(
+                    faults.RANK_DEATH, at=2):
+                t0 = time.perf_counter()
+                out = eng.join_arrays(r, s)
+                wall_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            eng.partition_manifest = None
+        return out, wall_ms, m
+
+    one_recovery("warm")                   # compile-warm the masked grids
+    out, recover_ms, m = one_recovery("timed")
+    recovern = int(m.counters.get(RECOVERN, 0))
+    if not (out.ok and out.matches == n):
+        print(f"ERROR: recovered join missed the oracle: "
+              f"{out.matches} != {n}", file=sys.stderr)
+        return 3
+    if not 0 < recovern < num_p:
+        print(f"ERROR: recompute was not partition-granular: RECOVERN="
+              f"{recovern} of {num_p} partitions", file=sys.stderr)
+        return 3
+    resumed = len(out.diagnostics.get("resumed_partitions") or [])
+    speedup = cold_ms / max(recover_ms, 1e-9)
+    print(f"note: kill-1-of-{nodes}: recovery {recover_ms:.0f} ms "
+          f"({recovern}/{num_p} partitions recomputed, {resumed} resumed) "
+          f"vs cold restart {cold_ms:.0f} ms -> {speedup:.2f}x",
+          file=sys.stderr)
+
+    result = {
+        "metric": "elastic_recovery_speedup",
+        "value": round(speedup, 3),
+        "unit": "cold_restart_over_recovery_wall",
+        "size": n,
+        "num_partitions": num_p,
+        "recover_ms": round(recover_ms, 1),
+        "cold_restart_ms": round(cold_ms, 1),
+        "recovern": recovern,
+        "resumed_partitions": resumed,
+        "ranklost": int(m.counters.get(RANKLOST, 0)),
+        "mepoch": int(m.counters.get(MEPOCH, 0)),
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
@@ -703,6 +826,11 @@ def main():
         # scatter): CPU-sized like --grid-bench — it gates the fused
         # partition kernel's speedup and unit constant, not chip throughput
         sys.exit(_run_partition_bench(check_baseline))
+    if "--recovery-bench" in argv:
+        # elastic-recovery A/B (robustness/recovery.py): CPU-sized like
+        # --chaos/--grid-bench — it gates kill-1-of-8 partition-level
+        # recovery against the cold restart, not chip throughput
+        sys.exit(_run_recovery_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

@@ -60,6 +60,23 @@ ms/Mtuple/pass constant the profile fitter recovers — all pinned
 lower-is-better, alongside the ``PARTFALLBACK`` counter (silent degrades
 to the XLA sort path; on a TPU backend more of them means the fused
 kernel stopped being auto-selected).
+
+A ``--recovery-bench`` BENCH json gates the elastic-recovery A/B
+(robustness/membership.py + recovery.py — kill-1-of-8 partition-level
+recovery vs the cold full restart it replaces):
+
+    {"metric": "elastic_recovery_speedup", "value": 2.34, "size": 262144,
+     "num_partitions": 16, "recover_ms": 334.9, "cold_restart_ms": 784.3,
+     "recovern": 2, "resumed_partitions": 14, "ranklost": 1, "mepoch": 1}
+
+The headline ``value`` is the wall ratio (cold restart over recovery,
+higher is better).  ``recover_ms``/``cold_restart_ms`` are walls;
+``recovern`` (partitions recomputed — the bench refuses to bless a run
+where it reaches the partition count, i.e. a veiled restart),
+``ranklost``, and ``mepoch`` (membership epochs burned per round) are
+pinned lower-is-better: a fleet that starts losing more ranks or
+fencing more epochs per round regresses even when each individual
+recovery still lands oracle-exact.
 """
 
 import argparse

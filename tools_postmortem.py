@@ -80,6 +80,21 @@ def main(argv=None) -> int:
             print(f"by reason:        {summary['by_reason']}")
             print(f"by failure class: {summary['by_failure_class']}")
             print(f"by rank:          {summary['by_rank']}")
+            print(f"by mesh epoch:    {summary['by_membership_epoch']}")
+            if summary["recovery_timeline"]:
+                print("recovery timeline:")
+                for ev in summary["recovery_timeline"]:
+                    what = ev.get("event")
+                    if what == "rank_lost":
+                        detail = (f"lost={ev.get('ranks')} "
+                                  f"cause={ev.get('cause')} "
+                                  f"survivors={ev.get('survivors')}")
+                    else:
+                        detail = (f"resumed={ev.get('resumed')} "
+                                  f"recomputed={ev.get('recomputed')} "
+                                  f"matches={ev.get('matches')}")
+                    print(f"  t={ev.get('t_epoch_s')} rank={ev.get('rank')} "
+                          f"{what} epoch={ev.get('epoch')} {detail}")
             for row in summary["rows"]:
                 if "error" in row:
                     print(f"  UNREADABLE {row['path']}: {row['error']}")
@@ -88,9 +103,11 @@ def main(argv=None) -> int:
                          if row.get("drift_pct") is not None else "")
                 qid = (f" query={row['query_id']}"
                        if row.get("query_id") else "")
+                mep = (f" epoch={row['membership_epoch']}"
+                       if row.get("membership_epoch") is not None else "")
                 print(f"  {row['path']}: {row['reason']} "
                       f"[{row['failure_class']}] rank={row['rank']} "
-                      f"strategy={row.get('strategy')}{drift}{qid}")
+                      f"strategy={row.get('strategy')}{drift}{qid}{mep}")
         bad = sum(1 for r in summary["rows"] if "error" in r)
         return 1 if bad else 0
     rc = 0

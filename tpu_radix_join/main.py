@@ -240,6 +240,30 @@ def build_parser() -> argparse.ArgumentParser:
                         "self-contained bundle_*.json here (default: "
                         "$TPU_RADIX_FORENSICS_DIR, else forensics/ under "
                         "--output-dir or --timeline-dir when one is set)")
+    p.add_argument("--elastic", choices=["on", "off"], default="off",
+                   help="elastic mesh recovery (robustness/membership.py + "
+                        "recovery.py): heartbeat an epoch-stamped lease per "
+                        "rank, detect peer loss at phase boundaries, fence "
+                        "the membership epoch, and finish the join on the "
+                        "survivor mesh by recomputing only the lost "
+                        "partitions host-side — a rank death becomes a "
+                        "recovered, oracle-exact run instead of a hang")
+    p.add_argument("--rank-lease-s", type=float, default=5.0, metavar="SEC",
+                   help="membership lease window: a rank whose lease file "
+                        "is older than SEC seconds is declared lost and the "
+                        "membership epoch fences (default 5.0)")
+    p.add_argument("--lease-dir", default=None,
+                   help="shared directory for membership lease files "
+                        "(default: $TPU_RADIX_LEASE_DIR, else leases/ under "
+                        "--output-dir or --timeline-dir, else a private "
+                        "tempdir — multi-process runs must share one)")
+    p.add_argument("--rank-death-at", type=int, default=None, metavar="N",
+                   help="arm the membership.rank_death chaos site at the "
+                        "N-th phase boundary (1-based): with "
+                        "TPU_RJ_RANK_DEATH_SUICIDE set this process dies "
+                        "for real (SIGKILL, the multi-rank recovery test's "
+                        "victim); otherwise the highest node rank's death "
+                        "is simulated and --elastic on recovers it")
     p.add_argument("--pipeline-repeats", action="store_true",
                    help="dispatch the --repeat joins asynchronously and "
                         "fence once (amortized-throughput methodology, "
@@ -263,6 +287,23 @@ def _forensics_dir(args):
          or (os.path.join(args.timeline_dir, "forensics")
              if args.timeline_dir else None))
     return d
+
+
+def _lease_dir(args):
+    """Where membership lease files live: explicit flag, then the
+    environment, then ``leases/`` under whichever artifact dir the run
+    already writes, else a private tempdir (fine single-process; a
+    multi-process world must share one via the flag or env)."""
+    import os
+    import tempfile
+
+    return (args.lease_dir
+            or os.environ.get("TPU_RADIX_LEASE_DIR")
+            or (os.path.join(args.output_dir, "leases")
+                if args.output_dir else None)
+            or (os.path.join(args.timeline_dir, "leases")
+                if args.timeline_dir else None)
+            or tempfile.mkdtemp(prefix="tpu_rj_leases_"))
 
 
 def _ledger_dir(args):
@@ -308,10 +349,15 @@ def _emit_failure_bundle(meas, exc, args, reason="failure"):
         return None
     try:
         from tpu_radix_join.observability.postmortem import write_bundle
+        # exceptions may carry structured forensics of their own (e.g.
+        # CoordinatorTimeout's attempts + cumulative backoff, RankLost's
+        # epoch) — fold them into the bundle next to the repr
+        extra = {"error": repr(exc)}
+        extra.update(getattr(exc, "bundle_extra", None) or {})
         return write_bundle(
             out_dir, meas, reason=reason,
             failure_class=getattr(exc, "failure_class", None),
-            config=vars(args), extra={"error": repr(exc)})
+            config=vars(args), extra=extra)
     except Exception as e:   # noqa: BLE001 - forensics must not mask
         print(f"[FORENSICS] bundle write failed: {e!r}", file=sys.stderr)
         return None
@@ -400,7 +446,7 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
     return 1 if (expected is not None and total != expected) else 0
 
 
-def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
+def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
     """Resident service mode: every request in the file flows through ONE
     :class:`~tpu_radix_join.service.JoinSession` — warm plan/capacity
     reuse across queries, admission control at the door, per-query
@@ -445,10 +491,18 @@ def _run_serve(args, cfg, meas, nodes, sampler=None) -> int:
     session = JoinSession(cfg, svc, measurements=meas,
                           plan_cache=plan_cache, profile=args.profile,
                           forensics_dir=_forensics_dir(args),
-                          ledger=ledger)
+                          ledger=ledger, membership=membership,
+                          elastic=args.elastic == "on")
     if sampler is not None:
-        # heartbeat ticks carry the live SLO/breaker snapshot in serve mode
-        sampler.extra = session._heartbeat_extra
+        # heartbeat ticks carry the live SLO/breaker snapshot in serve mode;
+        # with membership attached the lease write rides the same tick
+        if membership is not None:
+            lease_extra = membership.board.sampler_extra(
+                epoch_of=membership.epoch_of)
+            sampler.extra = (lambda hb=session._heartbeat_extra:
+                             {**hb(), **lease_extra()})
+        else:
+            sampler.extra = session._heartbeat_extra
 
     if args.serve == "-":
         lines = sys.stdin.read().splitlines()
@@ -593,11 +647,37 @@ def main(argv=None) -> int:
             os.path.join(mdir, f"{meas.node_id}.metrics.jsonl"),
             args.metrics_interval, measurements=meas)
         sampler.start()
+
+    # ------------------------------------------------- elastic membership
+    # (tpu_radix_join.robustness.membership): epoch-stamped leases in a
+    # shared dir.  Always on for multi-process worlds (loss DETECTION and
+    # classification are free safety); recovery itself is --elastic on.
+    membership = None
+    board = None
+    if args.elastic == "on" or distributed:
+        from tpu_radix_join.robustness.membership import (LeaseBoard,
+                                                          MembershipView)
+        board = LeaseBoard(_lease_dir(args), rank=jax.process_index(),
+                           num_ranks=jax.process_count(),
+                           lease_s=args.rank_lease_s, measurements=meas)
+        membership = MembershipView(board, measurements=meas)
+        board.heartbeat(0)           # first lease before any join work
+        if sampler is not None:
+            # liveness rides the telemetry cadence: every sampler tick
+            # heartbeats the lease and reports the membership epoch
+            sampler.extra = board.sampler_extra(epoch_of=membership.epoch_of)
     try:
         if args.serve is not None:
-            return _run_serve(args, cfg, meas, nodes, sampler=sampler)
-        return _run_driver(args, cfg, meas, distributed, nodes)
+            rc = _run_serve(args, cfg, meas, nodes, sampler=sampler,
+                            membership=membership)
+        else:
+            rc = _run_driver(args, cfg, meas, distributed, nodes,
+                             membership=membership)
     finally:
+        if board is not None:
+            # a clean exit withdraws the lease: peers see an absent (not
+            # stale) lease and a deliberate departure, not a silent death
+            board.withdraw(board.rank)
         uninstall_compile_monitor(meas)
         if sampler is not None:
             sampler.stop()
@@ -608,9 +688,19 @@ def main(argv=None) -> int:
             path = tracer.save(args.timeline_dir,
                                device_summary=meas.meta.get("trace"))
             print(f"[OBS] timeline spans stored {path}", file=sys.stderr)
+    if distributed and membership is not None and membership.lost:
+        # a survivor of a rank loss must NOT walk jax.distributed's atexit
+        # shutdown: the coordination service's shutdown barrier can never
+        # complete with a dead peer and LOG(FATAL)s the process (observed
+        # rc -6 after a fully recovered run).  Every artifact is already
+        # flushed above — exit hard with the honest code.
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(rc)
+    return rc
 
 
-def _run_driver(args, cfg, meas, distributed, nodes) -> int:
+def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     """Driver body after flag/observability setup (main() wraps this in the
     tracer/sampler lifecycle so every exit path exports its timeline)."""
     import contextlib
@@ -720,6 +810,23 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
         else:
             engine = HashJoin(cfg, measurements=meas, plan_cache=plan_cache)
 
+    # elastic wiring: membership view (loss detection + epoch fencing) and,
+    # with a checkpoint dir, the partition manifest (partition-level resume)
+    elastic = args.elastic == "on"
+    if engine is not None and (elastic or membership is not None):
+        manifest = None
+        if elastic and args.checkpoint_dir:
+            from tpu_radix_join.robustness.checkpoint import PartitionManifest
+            os.makedirs(args.checkpoint_dir, exist_ok=True)
+            fp = (f"elastic:{args.outer_kind}:{args.tuples_per_node * nodes}:"
+                  f"{args.seed}:{cfg.network_partition_count}")
+            manifest = PartitionManifest(
+                os.path.join(args.checkpoint_dir, "partitions.manifest"),
+                fingerprint=fp, measurements=meas)
+        engine.membership = membership
+        engine.elastic = elastic
+        engine.partition_manifest = manifest
+
     global_size = args.tuples_per_node * nodes
     meas.meta.update(tuples_per_node=args.tuples_per_node,
                      global_size=global_size, config=vars(args))
@@ -759,11 +866,23 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
 
     wd_ctx = (Watchdog(meas, timeout_s=args.watchdog_timeout,
                        kill=engine_killer(engine),
-                       bundle_dir=_forensics_dir(args), config=vars(args))
+                       bundle_dir=_forensics_dir(args), config=vars(args),
+                       membership=membership)
               if args.watchdog_timeout > 0 else contextlib.nullcontext())
+    if elastic and engine is not None:
+        # host-side regeneration source for recovery: the deterministic
+        # Relation specs, never the distributed arrays (hash_join.join()
+        # records the same pair on the Relations API path)
+        engine._elastic_rel = (inner, outer)
+    # --rank-death-at: arm the chaos site on THIS process; the victim of
+    # the multi-rank recovery test additionally sets the suicide env var
+    from tpu_radix_join.robustness import faults as _faults
+    death_ctx = (_faults.FaultInjector(seed=args.seed, measurements=meas)
+                 .arm(_faults.RANK_DEATH, at=args.rank_death_at)
+                 if args.rank_death_at else contextlib.nullcontext())
     times0 = phase_snapshot(meas)
     try:
-        with trace_ctx, wd_ctx:
+        with trace_ctx, wd_ctx, death_ctx:
             if args.pipeline_repeats and args.repeat > 1:
                 result = engine.join_arrays_pipelined(r_batch, s_batch,
                                                       args.repeat)
@@ -821,11 +940,24 @@ def _run_driver(args, cfg, meas, distributed, nodes) -> int:
         # round trip from the split phase columns (VERDICT r3 weak #6)
         meas.measure_dispatch_floor()
 
+    if (result.diagnostics or {}).get("recovered"):
+        d = result.diagnostics
+        print(f"[RESULTS] recovered: epoch={d.get('membership_epoch')} "
+              f"lost_ranks={d.get('lost_ranks')} "
+              f"resumed={len(d.get('resumed_partitions') or [])} "
+              f"recomputed={len(d.get('recovered_partitions') or [])}")
     # The reference's rank-0 aggregate report (Measurements.cpp:592-702):
     # multi-process worlds gather every rank's registry over the network
-    # first (Measurements.gather_all); rank 0 alone prints.
-    all_meas = meas.gather_all() if distributed else [meas]
-    if jax.process_index() == 0:
+    # first (Measurements.gather_all); rank 0 alone prints.  After a rank
+    # loss the gather itself is a collective on the dead mesh — skip it
+    # and let the lowest SURVIVOR report from its own registry.
+    lost = sorted(membership.lost) if membership is not None else []
+    all_meas = (meas.gather_all() if distributed and not lost else [meas])
+    if lost and membership.board.num_ranks > 1:
+        reporter = membership.board.rank == min(membership.survivors)
+    else:
+        reporter = jax.process_index() == 0
+    if reporter:
         if len(all_meas) == 1:
             # multi-rank runs get this line from print_results below
             print(f"[RESULTS] Tuples: {result.matches}")

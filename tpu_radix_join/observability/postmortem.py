@@ -290,6 +290,8 @@ def merge_bundles(paths) -> dict:
     reasons: dict = {}
     classes: dict = {}
     ranks: dict = {}
+    epochs: dict = {}
+    timeline = []
     rows = []
     t_min = t_max = None
     for p in paths:
@@ -308,13 +310,27 @@ def merge_bundles(paths) -> dict:
             t_min = t if t_min is None else min(t_min, t)
             t_max = t if t_max is None else max(t_max, t)
         pva = b.get("plan_vs_actual") or {}
+        # membership epoch: the exception's own stamp first (RankLost /
+        # StaleEpoch carry it in extra), else the registry's MEPOCH gauge
+        extra = b.get("extra") or {}
+        counters = b.get("counters") or {}
+        mepoch = extra.get("membership_epoch", counters.get("MEPOCH"))
+        epochs[str(mepoch)] = epochs.get(str(mepoch), 0) + 1
+        # the recovery timeline: rank_lost / recovery events from every
+        # bundle's event tail, aligned on the cross-process wall clock
+        for ev in b.get("events_tail") or []:
+            if ev.get("event") in ("rank_lost", "recovery"):
+                timeline.append(dict(ev, rank=rank, bundle=p))
         rows.append({"path": p, "reason": b.get("reason"),
                      "failure_class": fc, "rank": rank,
                      "query_id": b.get("query_id"),
+                     "membership_epoch": mepoch,
                      "strategy": pva.get("strategy")
                      or (b.get("plan") or {}).get("strategy"),
                      "drift_pct": pva.get("drift_pct"),
                      "created_epoch_s": t})
+    timeline.sort(key=lambda ev: ev.get("t_epoch_s") or 0)
     return {"bundles": len(rows), "by_reason": reasons,
             "by_failure_class": classes, "by_rank": ranks,
+            "by_membership_epoch": epochs, "recovery_timeline": timeline,
             "t_first": t_min, "t_last": t_max, "rows": rows}

@@ -77,7 +77,15 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # kernel stopped being selected
                             "partition_ms", "partition_kernel_ms",
                             "partition_sort_ms", "partition_unit_ms",
-                            "partfallback")
+                            "partfallback",
+                            # elastic-recovery tags (--recovery-bench and
+                            # the membership counters): more ranks lost,
+                            # a longer detect→recompute→splice wall, more
+                            # partitions recomputed, or a higher membership
+                            # epoch per round are all strictly worse — a
+                            # healthy fleet holds MEPOCH at 0
+                            "ranklost", "recover_ms", "recoverms",
+                            "recovern", "mepoch", "restart_ms")
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          "schema_version"}

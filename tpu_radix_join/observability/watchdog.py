@@ -70,18 +70,27 @@ class Watchdog:
                  kill: Optional[Callable] = None,
                  bundle_dir: Optional[str] = None,
                  poll_s: Optional[float] = None,
+                 membership=None,
                  **bundle_kw):
         self.measurements = measurements
         self.timeout_s = float(timeout_s)
         self.kill = kill
         self.bundle_dir = bundle_dir
+        #: duck-typed membership view (robustness/membership.py — the
+        #: observability layer stays import-free of robustness): an object
+        #: with ``suspect() -> Optional[Exception]``.  On a trip the
+        #: watchdog asks it FIRST — a stalled collective plus a lapsed
+        #: lease is a dead peer (``rank_lost``, recoverable), not a downed
+        #: backend (``backend_unavailable``, terminal).
+        self.membership = membership
         self.bundle_kw = bundle_kw
         # poll fast enough that a trip lands well inside one timeout
         # window even for sub-second test timeouts
         self.poll_s = poll_s if poll_s is not None \
             else max(0.01, min(1.0, self.timeout_s / 5.0))
         self.tripped = False
-        self.exc: Optional[HangDetected] = None
+        self.exc: Optional[Exception] = None   # HangDetected or the
+                                               # membership view's RankLost
         self.bundle_path: Optional[str] = None
         self.stacks = None
         self._stop = threading.Event()
@@ -117,31 +126,56 @@ class Watchdog:
                 self._trip(idle)
                 return
 
+    def _suspect(self):
+        """Stall triage: ask the membership view whether a lapsed lease
+        explains the stall.  Returns the exception to deliver (``None``
+        means no membership / all peers live — keep the hang verdict)."""
+        if self.membership is None:
+            return None
+        try:
+            return self.membership.suspect()
+        except Exception as e:   # noqa: BLE001 — triage must not mask
+            self.measurements.event("membership_suspect_error",
+                                    error=repr(e)[:200])
+            return None
+
     def _trip(self, idle_s: float) -> None:
         m = self.measurements
         self.tripped = True
         open_phases = list(m._starts)
         self.stacks = dump_all_stacks()
         from tpu_radix_join.performance.measurements import WDOGTRIP
-        m.incr(WDOGTRIP)
+        # "suspect rank, check leases, fence" before "kill self": a dead
+        # peer's stall is recoverable and must not be booked as a
+        # watchdog death (the chaos soak asserts WDOGTRIP==0 for
+        # recovered runs)
+        rank_exc = self._suspect()
+        cls = getattr(rank_exc, "failure_class", BACKEND_UNAVAILABLE)
+        reason = "rank_lost" if rank_exc is not None else "watchdog_trip"
+        if rank_exc is None:
+            m.incr(WDOGTRIP)
         m.event("watchdog_trip", idle_s=round(idle_s, 3),
                 open_phases=sorted(open_phases),
-                failure_class=BACKEND_UNAVAILABLE)
+                failure_class=cls)
         if self.bundle_dir:
             try:
                 from tpu_radix_join.observability.postmortem import \
                     write_bundle
                 self.bundle_path = write_bundle(
                     self.bundle_dir, measurements=m,
-                    reason="watchdog_trip",
-                    failure_class=BACKEND_UNAVAILABLE,
+                    reason=reason,
+                    failure_class=cls,
                     stacks=self.stacks,
                     extra={"idle_s": round(idle_s, 3),
                            "open_phases": sorted(open_phases)},
                     **self.bundle_kw)
             except Exception as e:   # noqa: BLE001 — forensics must not
                 m.event("bundle_error", error=repr(e)[:200])  # mask the hang
-        self.exc = HangDetected(idle_s, open_phases, self.bundle_path)
+        if rank_exc is not None:
+            rank_exc.bundle = self.bundle_path
+            self.exc = rank_exc
+        else:
+            self.exc = HangDetected(idle_s, open_phases, self.bundle_path)
         if self.kill is not None:
             try:
                 self.kill(self.exc)
@@ -155,7 +189,7 @@ def engine_killer(engine) -> Callable:
     exception at its next ``_check_cancel`` (phase boundary or stall
     poll)."""
 
-    def _kill(exc: HangDetected) -> None:
+    def _kill(exc: Exception) -> None:
         def _raise(phase: str, _exc=exc):
             raise _exc
         engine.cancel = _raise

@@ -24,6 +24,7 @@ from __future__ import annotations
 import contextlib
 import functools
 import os
+import signal
 import time
 from typing import NamedTuple, Optional
 
@@ -73,11 +74,13 @@ from tpu_radix_join.parallel.network_partitioning import (network_partition,
                                                           receive_checksums)
 from tpu_radix_join.parallel.window import (ExchangeResult, Window,
                                             parse_exchange_mode)
-from tpu_radix_join.performance.measurements import (BACKOFFMS, PACKRATIO,
+from tpu_radix_join.performance.measurements import (BACKOFFMS, MEPOCH,
+                                                     PACKRATIO, RANKLOST,
                                                      RETRYN, VCHK, VCHKN,
                                                      VFAIL, VREPAIR, XSTAGES)
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness import verify as _verify
+from tpu_radix_join.robustness.membership import RankLost, StaleEpoch
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
                                              RETRIES_EXHAUSTED,
                                              RETRYABLE_SIZING, RetryPolicy,
@@ -156,6 +159,23 @@ class HashJoin:
         # raises (e.g. DeadlineExceeded) to cancel the query between
         # programs — never mid-dispatch, so device state stays consistent
         self.cancel = None
+        # elastic mesh recovery (robustness/membership + recovery), wired
+        # attribute-style like ``cancel``: these are runtime services, not
+        # compile-time configuration — JoinConfig stays frozen and
+        # fingerprint-stable.  ``membership`` (MembershipView or None) is
+        # polled at every phase boundary; ``elastic`` makes join_arrays
+        # catch RankLost/StaleEpoch and finish on the survivors via
+        # partition-level recompute; ``partition_manifest``
+        # (checkpoint.PartitionManifest or None) records per-partition
+        # completion so recovery resumes instead of restarting
+        self.membership = None
+        self.elastic = False
+        self.partition_manifest = None
+        # Relation pair of the in-flight join(): recovery regenerates
+        # global key lanes host-side from these deterministic specs — it
+        # must never read a distributed array once a peer is dead (any
+        # collective, including a gather, would hang on the old mesh)
+        self._elastic_rel = None
         # resolved per join by _resolve_key_range (config.key_range): True
         # routes the 32-bit count probe to the full-range lexicographic
         # discipline instead of the 31-bit packed fast path
@@ -300,6 +320,13 @@ class HashJoin:
                 m.times_us[k] -= v
 
     # ------------------------------------------------------- plan cache
+    def _membership_epoch(self) -> int:
+        """Current membership epoch (0 = boot mesh, no view attached).
+        Part of every compiled-program key and capacity fingerprint: work
+        stamped with an older epoch must never run after the mesh shrank
+        — its collectives would address a dead peer."""
+        return self.membership.epoch if self.membership is not None else 0
+
     def _cache_config_fp(self) -> dict:
         """The JoinConfig fields that window capacities depend on — two
         configs agreeing here size identical shuffle windows for the same
@@ -313,7 +340,11 @@ class HashJoin:
                 "assignment_policy": cfg.assignment_policy,
                 "window_sizing": cfg.window_sizing,
                 "exchange_codec": cfg.exchange_codec,
-                "exchange_stages": cfg.exchange_stages}
+                "exchange_stages": cfg.exchange_stages,
+                # membership fence: capacities converged on the boot mesh
+                # must not warm-start a shrunken survivor mesh (and vice
+                # versa) — the epoch is part of the capacity identity
+                "membership_epoch": self._membership_epoch()}
 
     def _cache_eligible(self) -> bool:
         """Warm-start capacities only apply where the sizing pre-pass would
@@ -396,7 +427,12 @@ class HashJoin:
         outer timers (JTOTAL, SWINALLOC) are shifted past the compile so the
         reported phases stay reference-comparable: the reference's JTOTAL has
         no compile in it, and a compile-dominated JTOTAL understated the
-        engine's CLI throughput ~50x at 20M (VERDICT r3 weak #5)."""
+        engine's CLI throughput ~50x at 20M (VERDICT r3 weak #5).
+
+        Keys are prefixed with the membership epoch: a program lowered
+        against the pre-shrink mesh is fenced out after a rank loss
+        instead of deadlocking its collectives against a dead peer."""
+        key = (self._membership_epoch(), key)
         if key not in self._compiled:
             m = self.measurements
             if m:
@@ -1517,7 +1553,8 @@ class HashJoin:
             return Window(n, cap, ax, side, codec=codec, mode=mode,
                           fanout_bits=cfg.network_fanout_bits,
                           key_bound=key_bound, rid_bound=rid_bound,
-                          partition_impl=cfg.partition_impl)
+                          partition_impl=cfg.partition_impl,
+                          epoch=self._membership_epoch())
 
         return one(cap_r, "inner", rid_r), one(cap_s, "outer", rid_s)
 
@@ -1615,7 +1652,33 @@ class HashJoin:
         dispatched join, so JRATE = cumulative tuples / cumulative time.
         The reference driver runs exactly one join (main.cpp), so repeats
         carry no parity constraint.
+
+        With ``self.elastic`` set, a mid-join rank loss (the
+        ``membership.rank_death`` site, a lapsed lease surfacing at a
+        phase boundary, a fenced stale epoch, or a transport error a
+        lapsed lease explains) is absorbed: the join finishes on the
+        survivors via partition-level recompute (:meth:`_recover_join`)
+        instead of raising.  Successful joins record their realized
+        partitions into ``self.partition_manifest`` when one is attached.
         """
+        if not self.elastic and self.partition_manifest is None:
+            return self._join_arrays_inner(r, s, repeats)
+        try:
+            result = self._join_arrays_inner(r, s, repeats)
+        except BaseException as e:     # noqa: BLE001 — triaged below
+            if not self.elastic:
+                raise
+            exc = self._as_rank_lost(e)
+            if exc is None:
+                raise
+            return self._recover_join(r, s, exc, repeats)
+        self._manifest_record(result)
+        return result
+
+    def _join_arrays_inner(self, r: TupleBatch, s: TupleBatch,
+                           repeats: int = 1) -> JoinResult:
+        """:meth:`join_arrays` body (the wrapper above owns rank-loss
+        recovery and manifest recording)."""
         if repeats < 1:
             raise ValueError("repeats must be >= 1")
         if repeats > 1 and self.config.measure_phases:
@@ -1811,18 +1874,229 @@ class HashJoin:
         return result
 
     def _check_cancel(self, phase: str) -> None:
-        """Consult the cooperative cancellation hook between phases.  On
-        cancellation the open JTOTAL timer is closed first so the aborted
-        query still reports how long it ran before its budget expired."""
-        if self.cancel is None:
-            return
+        """Phase-boundary service point: consult the injectable
+        ``membership.rank_death`` site, the membership view (lease scan),
+        and the cooperative cancellation hook, in that order.  On any
+        raise the open JTOTAL timer is closed first so the aborted query
+        still reports how long it ran before it died."""
+        m = self.measurements
         try:
-            self.cancel(phase)
+            if _faults.fires(_faults.RANK_DEATH, m):
+                self._rank_death(phase)
+            if self.membership is not None:
+                # self-heartbeat rides the same boundary as the peer scan:
+                # a long compile/dispatch gap must not lapse OUR lease just
+                # because no sampler thread is ticking it
+                self.membership.board.heartbeat(self.membership.epoch)
+                newly = self.membership.check()
+                if newly:
+                    raise RankLost(newly[0], self.membership.epoch,
+                                   f"lease lapsed at phase {phase!r}")
+            if self.cancel is not None:
+                self.cancel(phase)
         except BaseException:
-            m = self.measurements
             if m is not None and "JTOTAL" in m._starts:
                 m.stop("JTOTAL")
             raise
+
+    # ------------------------------------------------------ elastic recovery
+    def _rank_death(self, phase: str) -> None:
+        """The ``membership.rank_death`` chaos site fired at this phase
+        boundary.  Two modes:
+
+          * **real** (``TPU_RJ_RANK_DEATH_SUICIDE`` set — the victim
+            process of the multi-rank recovery test): die the way a real
+            rank dies — instantly, silently, no cleanup, no goodbye;
+          * **simulated** (single process): the highest node rank is the
+            victim — declare it lost (bumping the epoch) and raise the
+            :class:`RankLost` the elastic path owns.
+        """
+        if os.environ.get("TPU_RJ_RANK_DEATH_SUICIDE"):
+            os.kill(os.getpid(), signal.SIGKILL)
+        m = self.measurements
+        victim = self.config.num_nodes - 1
+        if self.membership is not None:
+            epoch = self.membership.declare_lost(victim, cause="injected")
+        else:
+            epoch = 1
+            if m is not None:
+                m.incr(MEPOCH)
+                m.incr(RANKLOST)
+                m.event("rank_lost", ranks=[victim], epoch=epoch,
+                        cause="injected",
+                        survivors=self.config.num_nodes - 1)
+        raise RankLost(victim, epoch, f"injected at phase {phase!r}")
+
+    def _as_rank_lost(self, e: BaseException) -> Optional[RankLost]:
+        """Map a mid-join failure to the :class:`RankLost` recovery owns.
+
+        Direct RankLost/StaleEpoch (fault site, lease scan, watchdog
+        triage, epoch fence) always qualifies.  Other injected faults
+        keep their own failure classes.  A generic transport/runtime
+        error (gloo's broken pipe, an aborted collective) qualifies only
+        when the membership view confirms a lapsed lease — a dead peer
+        explains the error; anything else is not recovery's to absorb."""
+        if isinstance(e, RankLost):
+            return e
+        if isinstance(e, StaleEpoch):
+            mv = self.membership
+            rank = min(mv.lost) if mv is not None and mv.lost else 0
+            return RankLost(rank, e.current, "stale epoch fenced")
+        if isinstance(e, _faults.InjectedFault):
+            return None
+        if (self.membership is not None
+                and isinstance(e, (ConnectionError, OSError, RuntimeError,
+                                   TimeoutError))):
+            # a peer's death can surface as a transport error BEFORE its
+            # lease ages out (RST beats the lapse window): give the lease
+            # one full window to lapse before disowning the error
+            mv = self.membership
+            deadline = time.monotonic() + mv.board.lease_s + 1.0
+            while True:
+                lost = mv.check() or sorted(mv.lost)
+                if lost or time.monotonic() >= deadline:
+                    break
+                time.sleep(0.2)
+            if lost:
+                return RankLost(lost[0], self.membership.epoch,
+                                f"peer death surfaced as "
+                                f"{type(e).__name__}: {e}"[:200])
+        return None
+
+    def _lost_nodes(self, exc: RankLost) -> list:
+        """Expand lost PROCESS ranks into the node ranks they own: leases
+        are per process, partitions are owned by nodes, and a multi-device
+        process takes all its nodes down with it.  Single-process
+        simulation (no membership board): identity on the exception's
+        rank."""
+        n = self.config.num_nodes
+        mv = self.membership
+        if mv is None or mv.board.num_ranks <= 1:
+            r = int(getattr(exc, "rank", n - 1))
+            return [r if 0 <= r < n else n - 1]
+        nprocs = max(1, mv.board.num_ranks)
+        npp = max(1, n // nprocs)
+        lost_procs = sorted(mv.lost) or [int(getattr(exc, "rank", 0))]
+        out = []
+        for pr in lost_procs:
+            out.extend(range(pr * npp, min(n, (pr + 1) * npp)))
+        return [r for r in out if 0 <= r < n] or [n - 1]
+
+    def _recovery_scope(self):
+        """Node ranks THIS process recomputes for, or None for all (the
+        single-process simulation recomputes every lost partition; a
+        multi-process survivor takes only its reassigned share and merges
+        the rest through the shared manifest)."""
+        mv = self.membership
+        if (mv is None or mv.board.num_ranks <= 1
+                or self.partition_manifest is None):
+            return None
+        n = self.config.num_nodes
+        npp = max(1, n // max(1, mv.board.num_ranks))
+        me = mv.board.rank
+        return range(me * npp, (me + 1) * npp)
+
+    def _recover_join(self, r: TupleBatch, s: TupleBatch, exc: RankLost,
+                      repeats: int) -> JoinResult:
+        """Finish an aborted join on the survivor mesh (the elastic
+        tentpole, robustness/recovery.py): resume realized partitions
+        from the manifest, re-assign the rest across survivors, recompute
+        each as its own masked out-of-core join from host-regenerated
+        inputs, and splice — ok=True with the exact count, classified
+        ``recovered`` diagnostics, never a collective on the old mesh."""
+        m = self.measurements
+        cfg = self.config
+        num_p = cfg.network_partition_count
+        from tpu_radix_join.robustness import recovery as _recovery
+        # Host key lanes WITHOUT touching distributed arrays: prefer the
+        # deterministic Relation specs recorded by join(); fall back to
+        # fully-addressable batches (chaos runner / single-process).  A
+        # multi-process batch with no Relation spec cannot be recovered
+        # host-side — re-raise the classified loss for the caller.
+        if self._elastic_rel is not None:
+            rk, rhi = _recovery.host_keys(self._elastic_rel[0])
+            sk, shi = _recovery.host_keys(self._elastic_rel[1])
+        elif (getattr(r.key, "is_fully_addressable", True)
+                and getattr(s.key, "is_fully_addressable", True)):
+            rk = np.asarray(r.key)
+            sk = np.asarray(s.key)
+            rhi = None if r.key_hi is None else np.asarray(r.key_hi)
+            shi = None if s.key_hi is None else np.asarray(s.key_hi)
+        else:
+            raise exc
+        if m is not None and "JTOTAL" in m._starts:
+            m.stop("JTOTAL")   # the abort point; recovery has its own wall
+        epoch = max(1, self._membership_epoch(),
+                    int(getattr(exc, "epoch", 1)))
+        lost_nodes = self._lost_nodes(exc)
+        # advisory re-pricing for the shrunken mesh: best-effort — a
+        # missing profile must not block recovery
+        profile = workload = None
+        try:
+            from tpu_radix_join.planner.cost_model import Workload
+            from tpu_radix_join.planner.profile import load_profile
+            profile = load_profile()
+            workload = Workload(r_tuples=int(len(rk)),
+                                s_tuples=int(len(sk)),
+                                key_bound=self._static_key_bound,
+                                key_bits=cfg.key_bits,
+                                num_nodes=cfg.num_nodes)
+        except Exception:   # noqa: BLE001 — advisory only
+            profile = workload = None
+        span = (m.span("recovery", epoch=epoch,
+                       lost_ranks=list(lost_nodes))
+                if m is not None else contextlib.nullcontext())
+        with span:
+            plan = _recovery.plan_recovery(
+                num_nodes=cfg.num_nodes, num_partitions=num_p,
+                lost_ranks=lost_nodes, epoch=epoch,
+                manifest=self.partition_manifest,
+                weights=_recovery.partition_weights(rk, sk, num_p),
+                profile=profile, workload=workload)
+            matches, counts = _recovery.execute_recovery(
+                plan, rk, sk, rhi, shi,
+                only_rank=self._recovery_scope(),
+                slab=min(1 << 20, max(1, len(sk))),
+                pipeline=cfg.grid_pipeline, measurements=m,
+                manifest=self.partition_manifest)
+        counts_out = np.zeros(num_p, np.uint32)
+        for p, c in counts.items():
+            counts_out[p] = c % (1 << 32)
+        diag = dict(plan.to_diag(), rank_lost_detail=str(exc)[:200],
+                    failure_class="ok")
+        self._stamp_fault_sites(diag)
+        if m is not None:
+            m.incr("RESULTS", matches * repeats)
+            m.incr("RTUPLES", len(rk) * repeats)
+            m.incr("STUPLES", len(sk) * repeats)
+            m.derive_rates()
+        return JoinResult(matches=matches, ok=True,
+                          partition_counts=counts_out, diagnostics=diag)
+
+    def _manifest_record(self, result: JoinResult) -> None:
+        """Join-epilogue manifest write: record every realized partition
+        so a later death resumes at partition granularity.  Lines are
+        written strictly post-realization (kill-never-overclaims); shapes
+        with no per-partition decomposition (fallback/degraded results)
+        and recovered results (already recorded by execute_recovery) are
+        skipped."""
+        mf = self.partition_manifest
+        if mf is None or result is None or not result.ok:
+            return
+        if result.diagnostics and result.diagnostics.get("recovered"):
+            return
+        num_p = self.config.network_partition_count
+        counts = np.asarray(result.partition_counts)
+        if counts.size < num_p or counts.size % num_p:
+            return
+        per_p = counts.astype(np.uint64).reshape(-1, num_p).sum(axis=0)
+        n = self.config.num_nodes
+        epoch = self._membership_epoch()
+        # owner is forensic metadata (the recovery timeline), not an
+        # assignment contract — node stripe order stands in for the
+        # assignment map's exact ownership
+        mf.mark_many({int(p): int(c) for p, c in enumerate(per_p)},
+                     owner_of=lambda p: p % n, epoch=epoch)
 
     def _retry_backoff(self, attempt: int) -> None:
         """Optional pause between capacity-grow retries (``JoinConfig``
@@ -2223,10 +2497,14 @@ class HashJoin:
         Records the relations' static key bounds so ``key_range="auto"``
         resolves without the device max-key probe (:meth:`_resolve_key_range`)."""
         self._static_key_bound = max(inner.key_bound(), outer.key_bound())
+        # recovery's host-side input path: the seeded specs regenerate the
+        # global relations without touching a (possibly wedged) mesh
+        self._elastic_rel = (inner, outer)
         try:
             return self.join_arrays(self.place(inner), self.place(outer))
         finally:
             self._static_key_bound = None
+            self._elastic_rel = None
 
     def join_materialize(self, inner: Relation,
                          outer: Relation) -> MaterializedJoinResult:

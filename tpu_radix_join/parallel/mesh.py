@@ -45,6 +45,37 @@ def make_mesh(num_nodes: int | None = None, axis_name: str = "nodes") -> Mesh:
     return Mesh(np.asarray(devs[:n]), (axis_name,))
 
 
+def make_survivor_mesh(lost_nodes: Sequence[int],
+                       num_nodes: int | None = None,
+                       axis_name: str = "nodes") -> Mesh:
+    """A 1-D mesh over the boot mesh's devices MINUS the lost nodes'.
+
+    The elastic-recovery steady state (robustness/recovery.py): after a
+    rank loss fences the old mesh, survivors rebuild their collective
+    plane from live membership and recompile against it — same axis
+    vocabulary, smaller world.  ``lost_nodes`` are node indices into the
+    boot mesh's device order (the flat rank every shard_map program
+    used).  Raises when nothing survives: an empty mesh is not a mesh.
+
+    Single-process note: on virtual devices this drops the lost node's
+    device object from the grid; in a real multi-process job the dead
+    process's devices are unreachable and jax itself must be
+    re-initialized — there the helper documents the target shape for the
+    out-of-band recompute path rather than producing a dispatchable mesh
+    (a survivor must never dispatch a collective after a peer death;
+    recovery computes host-side).
+    """
+    devs = jax.devices()
+    n = num_nodes or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} nodes but only {len(devs)} devices")
+    lost = {int(r) for r in lost_nodes}
+    alive = [d for i, d in enumerate(devs[:n]) if i not in lost]
+    if not alive:
+        raise ValueError(f"all {n} nodes lost — no survivor mesh to build")
+    return Mesh(np.asarray(alive), (axis_name,))
+
+
 def make_hierarchical_mesh(
     num_hosts: int,
     num_nodes: int | None = None,

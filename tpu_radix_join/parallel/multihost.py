@@ -40,9 +40,25 @@ _initialized = False
 
 
 class CoordinatorTimeout(ConnectionError):
-    """Could not reach the distributed coordinator within policy."""
+    """Could not reach the distributed coordinator within policy.
+
+    Carries the retry history the terminal re-raise used to lose:
+    ``attempts`` (connect attempts made) and ``backoff_s`` (cumulative
+    seconds slept between them) — rendered into the message and picked
+    up by the forensics bundle (``bundle_extra``), so a post-mortem
+    distinguishes "died on the first dial" from "backed off for a minute
+    against a coordinator that never answered"."""
 
     failure_class = COORDINATOR_TIMEOUT
+
+    def __init__(self, msg: str, attempts: int = 1, backoff_s: float = 0.0):
+        super().__init__(msg)
+        self.attempts = attempts
+        self.backoff_s = backoff_s
+        #: merged into the post-mortem bundle's ``extra`` by the failure
+        #: path (main._emit_failure_bundle)
+        self.bundle_extra = {"coordinator_attempts": attempts,
+                             "coordinator_backoff_s": round(backoff_s, 3)}
 
 
 def _default_policy() -> RetryPolicy:
@@ -137,9 +153,13 @@ def initialize(coordinator_address: Optional[str] = None,
                 measurements=measurements,
                 label="coordinator_connect")
     except RetriesExhausted as e:
+        # the slept schedule is one delay per attempt pair actually made
+        backoff_s = sum(policy.schedule()[:max(0, e.attempts - 1)])
         raise CoordinatorTimeout(
             f"could not reach coordinator {coordinator_address} after "
-            f"{e.attempts} attempt(s): {e.last_error!r}") from e
+            f"{e.attempts} attempt(s) ({backoff_s:.1f}s cumulative "
+            f"backoff): {e.last_error!r}",
+            attempts=e.attempts, backoff_s=backoff_s) from e
     _initialized = True
     return jax.process_count() > 1
 

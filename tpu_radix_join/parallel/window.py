@@ -194,7 +194,8 @@ class Window:
                  fanout_bits: int = 0,
                  key_bound: Optional[int] = None,
                  rid_bound: Optional[int] = None,
-                 partition_impl: Optional[str] = None):
+                 partition_impl: Optional[str] = None,
+                 epoch: int = 0):
         if codec not in ("off", "pack"):
             raise ValueError(
                 f"window codec must be 'off' or 'pack', got {codec!r} "
@@ -209,6 +210,21 @@ class Window:
         self.key_bound = key_bound
         self.rid_bound = rid_bound
         self.partition_impl = partition_impl
+        #: membership-epoch stamp (robustness/membership.py): the mesh
+        #: shape this window's collectives were laid out against.  A
+        #: window is mesh-shape-specific — after a rank loss bumps the
+        #: epoch, dispatching it would address a dead peer, so callers
+        #: guard dispatch with :meth:`fence`.
+        self.epoch = epoch
+
+    def fence(self, view) -> None:
+        """Host-side dispatch guard: raise ``StaleEpoch`` (via
+        ``view.fence``, robustness/membership.MembershipView) when the
+        membership epoch moved past the one this window was built at —
+        a stale exchange dies loudly instead of deadlocking against a
+        peer that no longer exists.  No-op when ``view`` is None."""
+        if view is not None:
+            view.fence(self.epoch)
 
     def wire_spec(self, wide: bool) -> WireSpec:
         """The packed-wire geometry for this window's bounds (static)."""

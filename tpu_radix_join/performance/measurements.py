@@ -97,6 +97,14 @@ PLANDRIFT = "PLANDRIFT"    # gauge: |actual - predicted| JTOTAL as a percent of
                            # plan-vs-actual closed-loop signal; lower is better
 WDOGTRIP = "WDOGTRIP"      # hang-watchdog trips (observability/watchdog.py)
 PMBUNDLE = "PMBUNDLE"      # forensics bundles written (observability/postmortem)
+MEPOCH = "MEPOCH"          # gauge: current membership epoch (robustness/
+                           # membership.py) — bumps fence out stale collectives
+RANKLOST = "RANKLOST"      # ranks declared lost on lease lapse (membership.py)
+RECOVERN = "RECOVERN"      # partitions recomputed during elastic recovery
+                           # (robustness/recovery.py); < the total partition
+                           # count means resume was partition-granular
+RECOVERMS = "RECOVERMS"    # total elastic-recovery wall milliseconds (detect ->
+                           # re-plan -> recompute -> splice)
 NCOMPILE = "NCOMPILE"      # backend compiles observed via jax.monitoring
                            # (observability/compilemon.py); a resident serve
                            # session recompiling after warmup is a storm

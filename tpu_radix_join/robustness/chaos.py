@@ -195,9 +195,17 @@ class ChaosRunner:
                 self.bundle_dir))
         return out
 
+    def _bind(self, m) -> None:
+        """Per-run registry hook: the base runner's engine records no
+        counters (matching production one-shot runs where the registry
+        outlives the engine); :class:`RecoveryChaosRunner` overrides to
+        point the cached engine at this run's registry so RANKLOST /
+        RECOVERN / MEPOCH land where the soak can read them."""
+
     def _run(self, schedule: Schedule) -> RunOutcome:
         m = self._measurements_cls()
         self.measurements.append(m)
+        self._bind(m)
         inj = faults.FaultInjector(seed=schedule.seed, measurements=m)
         for site, kw in schedule.arm_dicts():
             inj.arm(site, **kw)
@@ -260,6 +268,91 @@ def soak(runs: int, base_seed: int = 0, runner: Optional[ChaosRunner] = None,
         "violations": sum(o.status == VIOLATION for o in outcomes),
         "failure_classes": sorted({o.failure_class for o in outcomes
                                    if o.failure_class}),
+    }
+    return outcomes, summary
+
+
+#: the elastic-recovery soak vocabulary: every array-path site PLUS the
+#: rank-death site (consulted at every ``_check_cancel`` phase boundary —
+#: hit 1 is "start", 2 is "sized", 3+ are the per-attempt "probe"
+#: boundaries, so a seeded hit index IS a seeded phase boundary)
+RECOVERY_SITES: Tuple[str, ...] = CHAOS_SITES + (faults.RANK_DEATH,)
+
+
+def generate_recovery_schedule(seed: int) -> Schedule:
+    """Always one ``membership.rank_death`` arm at a seeded phase
+    boundary (``at`` in 1..3 — start/sized/probe), plus 0-2 arms from
+    :data:`CHAOS_SITES` so rank loss composes with the faults it can
+    race (a corruption before the death, an overflow retry around it)."""
+    rng = random.Random(seed)
+    arms = [(faults.RANK_DEATH, (("at", rng.randint(1, 3)),))]
+    for site in rng.sample(CHAOS_SITES, rng.randint(0, 2)):
+        at = rng.randint(1, 2) if site == faults.SHUFFLE_OVERFLOW else 1
+        arms.append((site, (("at", at),)))
+    return Schedule(seed=seed, arms=tuple(arms))
+
+
+class RecoveryChaosRunner(ChaosRunner):
+    """:class:`ChaosRunner` with the elastic path armed.
+
+    The cached engine runs with ``elastic=True``: a fired
+    ``membership.rank_death`` must end in the exact oracle count
+    (recovered, PASS) — never a hang, never an overclaim; any escaping
+    rank loss still classifies as ``rank_lost``.  The default geometry
+    shrinks to 8 network partitions (``network_fanout_bits=3``): each
+    recovered partition is its own masked out-of-core join, and partition
+    count is the knob that bounds the soak's recompute wall.
+    """
+
+    def __init__(self, num_nodes: int = 4, size: int = 1 << 11,
+                 verify: str = "check", data_seed: int = 0,
+                 config_overrides: Optional[Dict[str, Any]] = None,
+                 bundle_dir: Optional[str] = None):
+        overrides = dict(config_overrides or {})
+        overrides.setdefault("network_fanout_bits", 3)
+        super().__init__(num_nodes=num_nodes, size=size, verify=verify,
+                         data_seed=data_seed, config_overrides=overrides,
+                         bundle_dir=bundle_dir)
+        self.engine.elastic = True
+
+    def _bind(self, m) -> None:
+        self.engine.measurements = m
+
+
+def soak_recovery(runs: int, base_seed: int = 0,
+                  runner: Optional[RecoveryChaosRunner] = None,
+                  on_outcome: Optional[Callable[[RunOutcome], None]] = None):
+    """Rank-death soak: N seeded recovery schedules through one elastic
+    runner.  The summary adds the recovery acceptance signals on top of
+    the base invariant fields: ``ranklost``/``recovered_partitions``/
+    ``max_epoch`` totals across the soak, and ``wdogtrip`` — which must
+    stay 0 (a recovered run never books a watchdog death; a nonzero
+    value means a stall was killed instead of triaged)."""
+    from tpu_radix_join.performance.measurements import (MEPOCH, RANKLOST,
+                                                         RECOVERN, WDOGTRIP)
+    runner = runner or RecoveryChaosRunner()
+    outcomes = []
+    for i in range(runs):
+        out = runner.run(generate_recovery_schedule(base_seed + i))
+        outcomes.append(out)
+        if on_outcome:
+            on_outcome(out)
+    regs = runner.measurements[-runs:]
+    summary = {
+        "runs": runs,
+        "base_seed": base_seed,
+        "verify": runner.config.verify,
+        "pass": sum(o.status == PASS for o in outcomes),
+        "classified": sum(o.status == CLASSIFIED for o in outcomes),
+        "violations": sum(o.status == VIOLATION for o in outcomes),
+        "failure_classes": sorted({o.failure_class for o in outcomes
+                                   if o.failure_class}),
+        "ranklost": sum(int(m.counters.get(RANKLOST, 0)) for m in regs),
+        "recovered_partitions": sum(int(m.counters.get(RECOVERN, 0))
+                                    for m in regs),
+        "max_epoch": max((int(m.counters.get(MEPOCH, 0)) for m in regs),
+                         default=0),
+        "wdogtrip": sum(int(m.counters.get(WDOGTRIP, 0)) for m in regs),
     }
     return outcomes, summary
 

@@ -32,10 +32,11 @@ resume (missing files count neither).
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import json
 import os
-from typing import Optional
+from typing import Dict, Optional
 
 from tpu_radix_join.performance.measurements import CKPTLOAD, CKPTSAVE
 from tpu_radix_join.robustness import faults as _faults
@@ -160,9 +161,17 @@ class AsyncCheckpointWriter:
         self._pending = None          # (state, done) | None
         self._busy = False
         self._stop = False
+        self._closed = False
         self._thread = threading.Thread(
             target=self._run, name="ckpt-write-behind", daemon=True)
         self._thread.start()
+        # The flush thread is a daemon: a clean sys.exit between save()
+        # and flush() would kill it mid-queue and silently drop the final
+        # checkpoint.  Registering close() guarantees the interpreter
+        # drains the queue on any non-SIGKILL exit; explicit close()
+        # unregisters so a long-lived process doesn't accumulate dead
+        # callbacks.
+        atexit.register(self.close)
 
     def save(self, state: dict, done: bool = False) -> None:
         with self._cond:
@@ -194,8 +203,155 @@ class AsyncCheckpointWriter:
                 self._cond.wait()
 
     def close(self) -> None:
-        """Flush outstanding writes and stop the thread (idempotent)."""
+        """Flush outstanding writes and stop the thread (idempotent —
+        safe to call explicitly, from ``with``-exit, and again from the
+        atexit hook)."""
         with self._cond:
+            if self._closed:
+                return
+            self._closed = True
             self._stop = True
             self._cond.notify_all()
         self._thread.join()
+        try:
+            atexit.unregister(self.close)
+        except Exception:       # pragma: no cover - interpreter teardown
+            pass
+
+    def __enter__(self) -> "AsyncCheckpointWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class PartitionManifest:
+    """Append-only per-partition completion manifest (elastic recovery).
+
+    Extends the checkpoint discipline from "one cursor per grid run" to
+    *partition granularity*: one JSONL line per realized network
+    partition —
+
+        {"fingerprint": {...}, "schema": 1}          # header line
+        {"partition": 3, "count": 4096, "owner": 1, "epoch": 0}
+        ...
+
+    Rules carried over from :class:`CheckpointManager`:
+
+      * **Kill-never-overclaims** — callers append a line only AFTER the
+        partition's count is realized on host; the last line of a
+        killed writer may be torn and is skipped on read, so the
+        manifest never claims unrealized work.
+      * **Fingerprint guard** — the header binds the manifest to one
+        (inputs, geometry) identity; a conflicting header raises
+        :class:`CheckpointMismatch` (resuming counts from a different
+        join would splice wrong totals), a corrupt header restarts from
+        zero.
+      * **Durability beats availability** — a failed append is swallowed
+        into a ``manifest_append_failed`` event (the run loses one
+        resume point, not its life).
+
+    Recovery (robustness/recovery.py) reads :meth:`completed` to skip
+    every realized partition and recompute exactly the lost rank's
+    unfinished ones; the ``owner``/``epoch`` stamps make the recovery
+    timeline reconstructible in post-mortem bundles.  Later lines win on
+    a per-partition key (a partition re-realized at a newer epoch
+    supersedes its old entry).
+    """
+
+    def __init__(self, path: str, fingerprint: dict, measurements=None):
+        self.path = path
+        self.fingerprint = fingerprint
+        self.measurements = measurements
+        self._ensure_header()
+
+    def _ensure_header(self) -> None:
+        m = self.measurements
+        header = None
+        if os.path.exists(self.path):
+            try:
+                with open(self.path) as f:
+                    header = json.loads(f.readline())
+            except (OSError, json.JSONDecodeError) as e:
+                if m is not None:
+                    m.event("manifest_corrupt", path=self.path,
+                            error=repr(e))
+                header = None
+        if header is not None:
+            if header.get("fingerprint") != self.fingerprint:
+                raise CheckpointMismatch(
+                    f"partition manifest {self.path} belongs to a different "
+                    f"join ({header.get('fingerprint')} != "
+                    f"{self.fingerprint}); remove it or use a distinct "
+                    f"fingerprint/tag")
+            return
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"fingerprint": self.fingerprint, "schema": 1}, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self.path)
+        except OSError as e:
+            if m is not None:
+                m.event("manifest_init_failed", path=self.path,
+                        error=repr(e))
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+    def mark_done(self, partition: int, count: int, owner: int,
+                  epoch: int = 0) -> bool:
+        """Append one realized-partition line; False (after an event) on
+        I/O failure instead of raising."""
+        m = self.measurements
+        rec = {"partition": int(partition), "count": int(count),
+               "owner": int(owner), "epoch": int(epoch)}
+        try:
+            with open(self.path, "a") as f:
+                json.dump(rec, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            if m is not None:
+                m.event("manifest_append_failed", path=self.path,
+                        error=repr(e))
+            return False
+        if m is not None:
+            m.incr(CKPTSAVE)
+        return True
+
+    def mark_many(self, counts: Dict[int, int], owner_of, epoch: int = 0
+                  ) -> int:
+        """Bulk append (join epilogue: every partition realized at once).
+        ``owner_of(p)`` maps a partition to its owner rank.  Returns the
+        number of lines written."""
+        n = 0
+        for p, c in counts.items():
+            if self.mark_done(p, c, owner_of(p), epoch):
+                n += 1
+        return n
+
+    def completed(self) -> Dict[int, dict]:
+        """``{partition: {"count", "owner", "epoch"}}`` of every realized
+        partition (later lines win); torn/corrupt lines are skipped —
+        the kill-never-overclaims read side."""
+        out: Dict[int, dict] = {}
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines[1:]:
+            try:
+                rec = json.loads(line)
+                out[int(rec["partition"])] = {
+                    "count": int(rec["count"]),
+                    "owner": int(rec["owner"]),
+                    "epoch": int(rec.get("epoch", 0))}
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+        return out

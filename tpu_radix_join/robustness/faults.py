@@ -53,10 +53,16 @@ BACKEND_STALL = "backend.stall"                # simulated hung collective:
                                                # raising — the watchdog's
                                                # downed-tunnel failure mode
                                                # (operators/hash_join.py)
+RANK_DEATH = "membership.rank_death"           # peer rank dies mid-run: its
+                                               # lease lapses and the local
+                                               # membership view must fence
+                                               # the epoch + recover instead
+                                               # of hanging (robustness/
+                                               # membership.py + recovery.py)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
-         CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL)
+         CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL, RANK_DEATH)
 
 
 class InjectedFault(RuntimeError):

@@ -1,0 +1,293 @@
+"""Lease-based rank membership with epoch fencing.
+
+The reference pipeline assumes every MPI rank survives the whole job; so
+did this port until now — a rank dying mid-run left its peers blocked in
+a collective until the watchdog converted the stall into a
+``backend_unavailable`` suicide.  This module turns rank loss into a
+*detectable, classified* condition:
+
+  * **Leases** — every rank heartbeats a small epoch-stamped JSON lease
+    into a shared run directory (:class:`LeaseBoard`).  Heartbeats ride
+    an existing cadence (the MetricsSampler's daemon tick via
+    :meth:`LeaseBoard.sampler_extra`, or any caller loop); a write is
+    atomic (tmp + ``os.replace``, the checkpoint.py discipline) and
+    *never raises* — a full disk must not kill a healthy rank.
+  * **Lapse detection** — a rank whose lease is older than ``lease_s``
+    (or which never wrote one within the grace window) is *lapsed*.
+    Wall-clock (``time.time``) timestamps are used deliberately: they
+    are the only clock comparable across processes, and the lease
+    window is seconds-coarse, far above credible host skew on one
+    machine or a TPU pod's NTP-disciplined hosts.
+  * **Epoch fencing** — :class:`MembershipView` turns lapses into a
+    declaration: the rank joins the ``lost`` set, ``RANKLOST`` ticks,
+    and the **membership epoch** bumps (``MEPOCH``).  Work stamped with
+    an older epoch (compiled plans, exchange plans, warm capacity
+    entries) is rejected via :meth:`MembershipView.fence` raising
+    :class:`StaleEpoch` — stale collectives from the old mesh shape die
+    loudly instead of deadlocking against a peer that no longer exists.
+
+Every survivor computes the same view independently from the shared
+lease directory — no coordinator, no broadcast (the assignment-map
+discipline: deterministic recomputation beats agreement protocols at
+this scale).
+
+The watchdog integration is duck-typed (observability stays
+dependency-free of robustness): :meth:`MembershipView.suspect` returns a
+ready-to-deliver :class:`RankLost` when a lapsed lease explains a stall,
+else None — the watchdog's trip path consults it before classifying the
+stall as ``backend_unavailable``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from tpu_radix_join.performance.measurements import MEPOCH, RANKLOST
+from tpu_radix_join.robustness.retry import RANK_LOST
+
+
+class RankLost(ConnectionError):
+    """A peer rank's lease lapsed (or its death was injected) mid-run.
+
+    Deliberately NOT blind-retryable (see retry.py's class catalog): the
+    remedy is the elastic-recovery path — fence the epoch, re-plan on
+    the survivor mesh, resume at partition granularity
+    (robustness/recovery.py) — never a same-shape rerun, which would
+    block on the same dead collective."""
+
+    failure_class = RANK_LOST
+
+    def __init__(self, rank: int, epoch: int, detail: str = ""):
+        super().__init__(
+            f"rank {rank} lost at membership epoch {epoch}"
+            + (f": {detail}" if detail else ""))
+        self.rank = rank
+        self.epoch = epoch
+        # forensics bundles fold this in next to the error repr
+        # (main._emit_failure_bundle), same contract as CoordinatorTimeout
+        self.bundle_extra = {"lost_rank": rank, "membership_epoch": epoch}
+
+
+class StaleEpoch(RuntimeError):
+    """Epoch-fenced rejection: work stamped with an old membership epoch
+    reached a collective/dispatch boundary after the mesh shrank.  Shares
+    the ``rank_lost`` failure class — the *cause* is the lost rank; the
+    fence merely converts what would have been a deadlock into a
+    classified exit the recovery path owns."""
+
+    failure_class = RANK_LOST
+
+    def __init__(self, stamped: int, current: int):
+        super().__init__(
+            f"stale membership epoch: work stamped epoch {stamped} but the "
+            f"mesh is at epoch {current} — re-plan on the survivor mesh")
+        self.stamped = stamped
+        self.current = current
+
+
+@dataclass(frozen=True)
+class Lease:
+    """One rank's most recent heartbeat."""
+
+    rank: int
+    epoch: int
+    t_epoch_s: float
+    pid: int
+    host: str
+    seq: int
+
+
+class LeaseBoard:
+    """Per-rank lease files in a shared run directory.
+
+    File ``lease_r<rank>.json`` holds one :class:`Lease` as JSON; writes
+    are atomic (tmp + ``os.replace``) so a reader never observes a torn
+    lease, and :meth:`heartbeat` never raises — losing one heartbeat to
+    a transient I/O error must not kill a healthy rank (the same
+    durability-beats-availability rule as checkpoint saves).
+    """
+
+    def __init__(self, run_dir: str, rank: int, num_ranks: int,
+                 lease_s: float = 5.0,
+                 clock: Callable[[], float] = time.time,
+                 measurements=None):
+        if lease_s <= 0:
+            raise ValueError(f"lease_s must be positive, got {lease_s}")
+        self.run_dir = run_dir
+        self.rank = int(rank)
+        self.num_ranks = int(num_ranks)
+        self.lease_s = float(lease_s)
+        self.clock = clock
+        self.measurements = measurements
+        self._seq = 0
+        self._t0 = clock()      # grace anchor for never-heartbeated ranks
+        os.makedirs(run_dir, exist_ok=True)
+
+    def lease_path(self, rank: int) -> str:
+        return os.path.join(self.run_dir, f"lease_r{rank}.json")
+
+    # ------------------------------------------------------------ heartbeat
+    def heartbeat(self, epoch: int = 0) -> dict:
+        """Write this rank's lease; returns the lease dict (merged into
+        sampler ticks by :meth:`sampler_extra`).  Never raises."""
+        self._seq += 1
+        rec = {"rank": self.rank, "epoch": int(epoch),
+               "t_epoch_s": self.clock(), "pid": os.getpid(),
+               "host": socket.gethostname(), "seq": self._seq}
+        path = self.lease_path(self.rank)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(rec, f)
+                f.flush()
+            os.replace(tmp, path)
+        except OSError as e:
+            rec = dict(rec, error=repr(e))
+            m = self.measurements
+            if m is not None:
+                m.event("lease_write_failed", rank=self.rank, error=repr(e))
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+        return rec
+
+    def sampler_extra(self, epoch_of: Optional[Callable[[], int]] = None
+                      ) -> Callable[[], dict]:
+        """A zero-arg hook for ``MetricsSampler(extra=...)``: every sampler
+        tick heartbeats the lease and folds it into the metrics record —
+        liveness rides the telemetry cadence instead of a second thread.
+        ``epoch_of`` supplies the current membership epoch per tick (e.g.
+        ``view.epoch_of``)."""
+        def _extra() -> dict:
+            ep = epoch_of() if epoch_of is not None else 0
+            return {"lease": self.heartbeat(ep)}
+        return _extra
+
+    # -------------------------------------------------------------- reading
+    def read(self, rank: int) -> Optional[Lease]:
+        """The rank's current lease, or None (missing/torn files read as
+        absent — a torn lease is indistinguishable from a dead writer
+        and ages out the same way)."""
+        try:
+            with open(self.lease_path(rank)) as f:
+                d = json.load(f)
+            return Lease(rank=int(d["rank"]), epoch=int(d["epoch"]),
+                         t_epoch_s=float(d["t_epoch_s"]), pid=int(d["pid"]),
+                         host=str(d.get("host", "")), seq=int(d.get("seq", 0)))
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
+
+    def snapshot(self) -> Dict[int, Lease]:
+        return {r: lease for r in range(self.num_ranks)
+                if (lease := self.read(r)) is not None}
+
+    def lapsed(self, now: Optional[float] = None) -> List[int]:
+        """Ranks whose lease age exceeds ``lease_s``.  A rank that never
+        wrote a lease lapses once the same window has elapsed since this
+        board was created (startup grace: a slow-booting peer is not
+        declared dead before it had one full window to appear)."""
+        now = self.clock() if now is None else now
+        out = []
+        for r in range(self.num_ranks):
+            if r == self.rank:
+                continue          # self-liveness is tautological
+            lease = self.read(r)
+            anchor = self._t0 if lease is None else lease.t_epoch_s
+            if now - anchor > self.lease_s:
+                out.append(r)
+        return out
+
+    def withdraw(self, rank: int) -> None:
+        """Delete a rank's lease — the chaos/test hook for simulating an
+        instant death without waiting out the lapse window."""
+        try:
+            os.remove(self.lease_path(rank))
+        except OSError:
+            pass
+
+
+class MembershipView:
+    """Fenced membership state derived from a :class:`LeaseBoard`.
+
+    ``epoch`` starts at 0 (the boot mesh) and bumps once per
+    :meth:`check` batch that declares new losses — ``MEPOCH`` counts the
+    bumps, so the counter *is* the epoch.  ``lost`` only grows: a rank
+    that re-appears after being declared lost must rejoin at a future
+    epoch (join-side elasticity, ROADMAP item 2's other half), never
+    silently re-enter the current one — its in-flight state is gone.
+    """
+
+    def __init__(self, board: LeaseBoard, measurements=None):
+        self.board = board
+        self.measurements = measurements
+        self.epoch = 0
+        self.lost: set = set()
+
+    # epoch accessor shaped for LeaseBoard.sampler_extra(epoch_of=...)
+    def epoch_of(self) -> int:
+        return self.epoch
+
+    @property
+    def survivors(self) -> List[int]:
+        return [r for r in range(self.board.num_ranks) if r not in self.lost]
+
+    def _declare(self, ranks: List[int], cause: str) -> List[int]:
+        fresh = [r for r in ranks if r not in self.lost]
+        if not fresh:
+            return []
+        self.lost.update(fresh)
+        self.epoch += 1
+        m = self.measurements
+        if m is not None:
+            m.incr(MEPOCH)
+            m.incr(RANKLOST, len(fresh))
+            m.event("rank_lost", ranks=fresh, epoch=self.epoch, cause=cause,
+                    survivors=len(self.survivors))
+        return fresh
+
+    def check(self, now: Optional[float] = None) -> List[int]:
+        """Scan leases; declare newly lapsed ranks lost (one epoch bump
+        per batch regardless of how many lapsed together — a host loss
+        takes its ranks in one fence, not N).  Returns the newly lost
+        ranks.  Cheap enough for phase-boundary polling: one small-file
+        read per peer."""
+        return self._declare(self.board.lapsed(now), cause="lease_lapse")
+
+    def declare_lost(self, rank: int, cause: str = "declared") -> int:
+        """Explicit declaration (watchdog suspicion confirmed, chaos
+        injection).  Withdraws the lease too so every survivor's next
+        scan converges on the same verdict.  Returns the new epoch."""
+        self.board.withdraw(rank)
+        self._declare([rank], cause=cause)
+        return self.epoch
+
+    # --------------------------------------------------------------- fencing
+    def fence(self, stamped_epoch: int) -> None:
+        """Reject work stamped with an old epoch (see :class:`StaleEpoch`)."""
+        if stamped_epoch != self.epoch:
+            raise StaleEpoch(stamped_epoch, self.epoch)
+
+    def require_live(self, rank: int) -> None:
+        if rank in self.lost:
+            raise RankLost(rank, self.epoch, "rank already declared lost")
+
+    # ------------------------------------------------------- watchdog bridge
+    def suspect(self) -> Optional[RankLost]:
+        """The watchdog's stall triage: a stalled collective *plus* a
+        lapsed lease is a dead peer, not a downed backend.  Runs a lease
+        scan; if any rank is (or just became) lost, returns a
+        :class:`RankLost` for the watchdog to deliver — recovery owns it
+        from there.  Returns None when every peer is live (the stall is
+        the backend's fault; the watchdog keeps its
+        ``backend_unavailable`` verdict)."""
+        self.check()
+        if not self.lost:
+            return None
+        rank = min(self.lost)
+        return RankLost(rank, self.epoch, "lease lapsed during stall")

@@ -1,0 +1,246 @@
+"""Elastic recovery: re-plan on shrink + partition-level resume.
+
+The companion to robustness/membership.py — once a rank is declared lost
+(:class:`~tpu_radix_join.robustness.membership.RankLost`), this module
+turns the aborted join into a bounded recompute instead of a restart:
+
+  1. **Resume** — read the per-partition completion manifest
+     (checkpoint.PartitionManifest): every partition some rank realized
+     before the death is *done*, its count is trusted (every manifest
+     line is written post-realization, so trusting it can never
+     overclaim).
+  2. **Re-plan on shrink** — the not-done partitions are re-assigned
+     across the survivor set with the same deterministic machinery the
+     boot mesh used (``histograms/assignment_map``): load-aware LPT over
+     measured per-partition weights when histograms are available,
+     round-robin otherwise.  Every survivor computes the identical map
+     from the shared lease/manifest state — no coordinator.  The planner
+     re-prices strategies for the shrunken mesh (`plan_join` on a
+     ``num_nodes=len(survivors)`` workload) so the post-recovery steady
+     state doesn't run the old mesh's plan.
+  3. **Recompute out-of-band** — each unfinished partition re-joins as
+     its own masked ``chunked_join_grid`` (``(key & (P-1)) == p``), the
+     exact machinery ``verify="repair"`` already trusts, over inputs
+     regenerated host-side from the deterministic seeded Relation specs.
+     Nothing touches the (possibly wedged) distributed arrays: a
+     survivor must never issue a collective against a mesh containing a
+     dead rank.
+
+Counters: ``RECOVERN`` per partition recomputed (strictly below the
+partition count whenever the manifest resumed anything — the
+acceptance-bar signal that resume was partition-granular, not a veiled
+restart), ``RECOVERMS`` for the detect→re-plan→recompute→splice wall.
+Every recovered result's diagnostics carry the full recovery record
+(lost ranks, epoch, resumed/recomputed partitions, reassignment,
+re-priced plan), which the post-mortem bundle and
+``tools_postmortem.py --merge`` render as the recovery timeline.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.histograms.assignment_map import (load_aware_assignment,
+                                                      round_robin_assignment)
+from tpu_radix_join.performance.measurements import RECOVERMS, RECOVERN
+from tpu_radix_join.robustness.membership import RankLost
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPlan:
+    """The survivor-side decision record (identical on every survivor)."""
+
+    epoch: int                      # membership epoch the recovery fences to
+    lost_ranks: Tuple[int, ...]
+    survivors: Tuple[int, ...]
+    num_partitions: int
+    #: partitions whose counts resume from the manifest (trusted, done)
+    resumed: Dict[int, int]
+    #: partitions to recompute, in ascending order
+    recompute: Tuple[int, ...]
+    #: recompute partition -> survivor rank that owns the recompute
+    reassignment: Dict[int, int]
+    #: re-priced strategy for the shrunken mesh (advisory; "" = no profile)
+    replan_strategy: str = ""
+    replan_predicted_ms: float = 0.0
+
+    def to_diag(self) -> dict:
+        return {
+            "recovered": True,
+            "membership_epoch": self.epoch,
+            "lost_ranks": list(self.lost_ranks),
+            "survivors": list(self.survivors),
+            "resumed_partitions": sorted(self.resumed),
+            "recovered_partitions": list(self.recompute),
+            "recovery_assignment": {str(p): r
+                                    for p, r in self.reassignment.items()},
+            "replan_strategy": self.replan_strategy,
+            "replan_predicted_ms": round(self.replan_predicted_ms, 3),
+        }
+
+
+def plan_recovery(*, num_nodes: int, num_partitions: int,
+                  lost_ranks, epoch: int, manifest=None,
+                  weights: Optional[np.ndarray] = None,
+                  profile=None, workload=None) -> RecoveryPlan:
+    """Build the survivor-side :class:`RecoveryPlan`.
+
+    ``manifest`` (checkpoint.PartitionManifest) supplies resumable
+    counts; ``weights`` (per-partition R+S tuple counts, host array of
+    length ``num_partitions``) switches the reassignment from
+    round-robin to load-aware LPT; ``profile``/``workload``
+    (planner.profile.DeviceProfile, planner.cost_model.Workload) trigger
+    the shrunken-mesh re-pricing.
+    """
+    lost = tuple(sorted(set(int(r) for r in lost_ranks)))
+    survivors = tuple(r for r in range(num_nodes) if r not in lost)
+    if not survivors:
+        raise RankLost(lost[0] if lost else 0, epoch,
+                       "no survivors to recover onto")
+    resumed: Dict[int, int] = {}
+    if manifest is not None:
+        for p, rec in manifest.completed().items():
+            if 0 <= p < num_partitions:
+                resumed[p] = rec["count"]
+    recompute = tuple(p for p in range(num_partitions) if p not in resumed)
+    # deterministic reassignment over the SURVIVOR count, then mapped back
+    # to survivor rank ids — each survivor recomputes the same map, the
+    # assignment_map no-broadcast discipline
+    if weights is not None and len(recompute) > 0:
+        w = np.zeros(num_partitions, np.float32)
+        w[list(recompute)] = np.asarray(weights, np.float32)[list(recompute)]
+        amap = np.asarray(load_aware_assignment(
+            jnp.asarray(w), jnp.zeros_like(jnp.asarray(w)), len(survivors)))
+    else:
+        amap = np.asarray(round_robin_assignment(num_partitions,
+                                                 max(1, len(survivors))))
+    reassignment = {int(p): int(survivors[int(amap[p])]) for p in recompute}
+    strategy, predicted_ms = "", 0.0
+    if profile is not None and workload is not None:
+        try:
+            from tpu_radix_join.planner.plan import plan_join
+            shrunk = dataclasses.replace(workload,
+                                         num_nodes=len(survivors))
+            plan, _ = plan_join(profile, shrunk)
+            strategy, predicted_ms = plan.strategy, plan.predicted_ms
+        except Exception:
+            pass    # re-pricing is advisory; recovery must not die on it
+    return RecoveryPlan(epoch=epoch, lost_ranks=lost, survivors=survivors,
+                        num_partitions=num_partitions, resumed=resumed,
+                        recompute=recompute, reassignment=reassignment,
+                        replan_strategy=strategy,
+                        replan_predicted_ms=predicted_ms)
+
+
+def host_keys(rel) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Regenerate a Relation's global key lanes host-side.
+
+    Recovery's input path: the seeded generators are deterministic, so a
+    survivor reconstructs the *global* relation (including the dead
+    rank's shards) without touching a single distributed array — the one
+    property that makes host-side recovery possible at all."""
+    shards = [rel.shard_np(i) for i in range(rel.num_nodes)]
+    keys = np.concatenate([sh[0] for sh in shards])
+    hi = (np.concatenate([sh[1] for sh in shards])
+          if rel.key_bits == 64 else None)
+    return keys, hi
+
+
+def partition_weights(r_keys: np.ndarray, s_keys: np.ndarray,
+                      num_partitions: int) -> np.ndarray:
+    """Per-partition R+S tuple counts (the LPT weight model) from host
+    key lanes — one bincount pass each."""
+    mask = np.uint64(num_partitions - 1)
+    rw = np.bincount((r_keys.astype(np.uint64) & mask).astype(np.int64),
+                     minlength=num_partitions)
+    sw = np.bincount((s_keys.astype(np.uint64) & mask).astype(np.int64),
+                     minlength=num_partitions)
+    return (rw + sw).astype(np.float32)
+
+
+def execute_recovery(plan: RecoveryPlan,
+                     r_keys: np.ndarray, s_keys: np.ndarray,
+                     r_hi: Optional[np.ndarray] = None,
+                     s_hi: Optional[np.ndarray] = None,
+                     *, only_rank=None,
+                     slab: int = 1 << 20, pipeline: str = "off",
+                     measurements=None, manifest=None,
+                     clock=time.monotonic) -> Tuple[int, Dict[int, int]]:
+    """Recompute the plan's unfinished partitions; returns
+    ``(matches, counts)`` where ``counts`` maps every partition this call
+    accounted for (resumed + recomputed) to its realized count.
+
+    ``only_rank`` (an int or an iterable of ints — a multi-node process
+    owns several node ranks) restricts the recompute to partitions the
+    reassignment gave those survivors (the multi-survivor path: each
+    appends its realized partitions to the shared ``manifest`` and the
+    totals merge through it); None recomputes everything (single
+    survivor, or the in-process simulation).  Each partition is one masked
+    ``chunked_join_grid`` — the ``verify="repair"`` machinery — under a
+    ``recover_partition`` span, and is marked done in the manifest only
+    AFTER its count is realized (kill-never-overclaims carries over).
+    """
+    from tpu_radix_join.ops.chunked import chunked_join_grid
+    m = measurements
+    t0 = clock()
+    counts: Dict[int, int] = dict(plan.resumed)
+    mask = np.uint64(plan.num_partitions - 1)
+    mine = (None if only_rank is None
+            else {int(only_rank)} if isinstance(only_rank, int)
+            else {int(r) for r in only_rank})
+    todo = [p for p in plan.recompute
+            if mine is None or plan.reassignment[p] in mine]
+    recovered = 0
+    for p in todo:
+        rsel = (r_keys.astype(np.uint64) & mask) == p
+        ssel = (s_keys.astype(np.uint64) & mask) == p
+        cnt = 0
+        if rsel.any() and ssel.any():
+            span = (m.span("recover_partition", partition=int(p),
+                           owner=plan.reassignment[p])
+                    if m is not None else _null())
+            with span:
+                cnt = chunked_join_grid(
+                    [TupleBatch(
+                        key=jnp.asarray(r_keys[rsel]),
+                        rid=jnp.zeros(int(rsel.sum()), jnp.uint32),
+                        key_hi=None if r_hi is None
+                        else jnp.asarray(r_hi[rsel]))],
+                    [TupleBatch(
+                        key=jnp.asarray(s_keys[ssel]),
+                        rid=jnp.zeros(int(ssel.sum()), jnp.uint32),
+                        key_hi=None if s_hi is None
+                        else jnp.asarray(s_hi[ssel]))],
+                    max(1, min(slab, int(ssel.sum()))), measurements=m,
+                    pipeline=pipeline)
+        counts[p] = int(cnt)
+        recovered += 1
+        if manifest is not None:
+            manifest.mark_done(p, int(cnt), plan.reassignment[p],
+                               epoch=plan.epoch)
+    if manifest is not None and only_rank is not None:
+        # multi-survivor merge: fold in partitions other survivors
+        # realized (their manifest lines are post-realization, so this
+        # can only under- never over-count relative to the oracle)
+        for p, rec in manifest.completed().items():
+            counts.setdefault(int(p), rec["count"])
+    matches = int(sum(counts.values()))
+    if m is not None:
+        m.incr(RECOVERN, recovered)
+        m.incr(RECOVERMS, int((clock() - t0) * 1000))
+        m.event("recovery", epoch=plan.epoch,
+                lost_ranks=list(plan.lost_ranks),
+                resumed=len(plan.resumed), recomputed=recovered,
+                matches=matches)
+    return matches, counts
+
+
+def _null():
+    import contextlib
+    return contextlib.nullcontext()

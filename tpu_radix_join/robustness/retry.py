@@ -45,6 +45,12 @@ and surfaced by main.py / bench reports):
     The query never ran; resubmitting later is safe by construction.
   * ``deadline_exceeded``    — the query's latency budget expired between
     pipeline phases (service/deadline.py cooperative cancellation).
+  * ``rank_lost``            — a peer rank's membership lease lapsed
+    mid-run (robustness/membership.py).  NOT blind-retryable: the remedy
+    is the explicit elastic-recovery path (robustness/recovery.py) —
+    fence the membership epoch, re-plan on the survivor mesh, and resume
+    at partition granularity — not a same-shape rerun, which would hang
+    on the same dead collective.
 """
 
 from __future__ import annotations
@@ -71,6 +77,7 @@ RETRIES_EXHAUSTED = "retries_exhausted"
 BACKEND_UNAVAILABLE = "backend_unavailable"
 ADMISSION_REJECTED = "admission_rejected"
 DEADLINE_EXCEEDED = "deadline_exceeded"
+RANK_LOST = "rank_lost"
 
 #: diagnostics flags -> class, in priority order (fatal classes outrank
 #: capacity: a key-contract violation must never look retryable just because

@@ -46,8 +46,10 @@ from typing import Callable, Dict, List, Optional
 
 from tpu_radix_join.core.config import JoinConfig, ServiceConfig
 from tpu_radix_join.performance.measurements import (COMPILEMS, JHIST,
-                                                     NCOMPILE, QDEADLINE,
-                                                     QDEGRADED, QWARM)
+                                                     MEPOCH, NCOMPILE,
+                                                     QDEADLINE, QDEGRADED,
+                                                     QWARM, RANKLOST,
+                                                     RECOVERMS, RECOVERN)
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness.retry import (BACKEND_UNAVAILABLE,
                                              DEADLINE_EXCEEDED, OK)
@@ -137,10 +139,23 @@ class JoinSession:
                  measurements=None, plan_cache=None, profile: str = "v5e_lite",
                  clock: Callable[[], float] = time.monotonic,
                  forensics_dir: Optional[str] = None,
-                 ledger=None):
+                 ledger=None, membership=None, elastic: bool = False,
+                 partition_manifest=None):
         from tpu_radix_join.operators.hash_join import HashJoin
 
         self.config = config
+        #: elastic mesh recovery services (robustness/membership +
+        #: checkpoint.PartitionManifest), threaded onto every engine the
+        #: session builds: the session SURVIVES a mesh change — a
+        #: mid-query rank loss recovers inside join_arrays (classified
+        #: ``recovered`` diagnostics, exact count), later queries compile
+        #: against the new epoch (the engine's compile keys and capacity
+        #: fingerprints carry it), and the breaker keeps serving —
+        #: degraded if it was already open — instead of the whole session
+        #: dying with the rank
+        self.membership = membership
+        self.elastic = elastic
+        self.partition_manifest = partition_manifest
         self.service = service or ServiceConfig()
         self.measurements = measurements
         #: cross-run telemetry ledger (observability/ledger.py): when set,
@@ -179,6 +194,7 @@ class JoinSession:
         self.slo = SLORecorder()
         self.engine = HashJoin(config, measurements=measurements,
                                plan_cache=plan_cache)
+        self._wire_elastic(self.engine)
         self._cpu_engine = None         # built lazily on first open-state query
         self._place_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
@@ -234,6 +250,15 @@ class JoinSession:
                 on_outcome(out)
 
     # ------------------------------------------------------------ internals
+    def _wire_elastic(self, engine) -> None:
+        """Attach the session's elastic-recovery services to an engine
+        (primary at construction, CPU fallback on first build) — both
+        must agree on membership so a rank loss observed on either path
+        fences the same epoch."""
+        engine.membership = self.membership
+        engine.elastic = self.elastic
+        engine.partition_manifest = self.partition_manifest
+
     def _degraded_engine(self):
         """The CPU fallback engine, built once on first use (the breaker's
         open-state serving path — robustness/degrade.py's construction
@@ -243,6 +268,7 @@ class JoinSession:
             self._cpu_engine, info = build_cpu_engine(
                 self.config, measurements=self.measurements,
                 plan_cache=self.plan_cache)
+            self._wire_elastic(self._cpu_engine)
             m = self.measurements
             if m is not None:
                 m.event("degrade", to="cpu", num_nodes=info["num_nodes"],
@@ -330,6 +356,20 @@ class JoinSession:
                 cls = (result.diagnostics or {}).get(
                     "failure_class") or (OK if result.ok else UNCLASSIFIED)
                 status = "ok" if result.ok else "failed"
+                if (result.diagnostics or {}).get("recovered"):
+                    # a mid-query rank loss was absorbed by the elastic
+                    # path: the outcome is ok with the exact count, but
+                    # the mesh change is first-class evidence
+                    if m is not None:
+                        m.event("query_recovered",
+                                query_id=request.query_id,
+                                epoch=result.diagnostics.get(
+                                    "membership_epoch"),
+                                lost_ranks=result.diagnostics.get(
+                                    "lost_ranks"))
+                    detail = ("recovered from rank loss: "
+                              + str(result.diagnostics.get(
+                                    "lost_ranks")))[:500]
                 if status == "failed":
                     detail = str({k: v for k, v in
                                   (result.diagnostics or {}).items()
@@ -458,9 +498,14 @@ class JoinSession:
         return self._sampler
 
     def _heartbeat_extra(self) -> dict:
-        return {"slo": self.slo.snapshot(),
-                "breaker": self.breaker.snapshot(),
-                "queue_depth": self.queue.depth()}
+        out = {"slo": self.slo.snapshot(),
+               "breaker": self.breaker.snapshot(),
+               "queue_depth": self.queue.depth()}
+        if self.membership is not None:
+            out["membership"] = {"epoch": self.membership.epoch,
+                                 "lost": sorted(self.membership.lost),
+                                 "survivors": self.membership.survivors}
+        return out
 
     def summary(self) -> dict:
         """Final serve report: SLO tags + breaker/queue/cache state."""
@@ -476,6 +521,11 @@ class JoinSession:
             out["ncompile"] = int(m.counters.get(NCOMPILE, 0))
             out["compile_ms"] = int(m.counters.get(COMPILEMS, 0))
             out["recompile_storms"] = self._recompile_storms
+            if m.counters.get(RANKLOST):
+                out["ranks_lost"] = int(m.counters.get(RANKLOST, 0))
+                out["membership_epoch"] = int(m.counters.get(MEPOCH, 0))
+                out["recovered_partitions"] = int(m.counters.get(RECOVERN, 0))
+                out["recover_ms"] = int(m.counters.get(RECOVERMS, 0))
         return out
 
     def close(self) -> None:
