@@ -486,8 +486,9 @@ def test_bench_backend_unavailable_json():
     line = [l for l in p.stdout.splitlines() if l.startswith("{")][-1]
     doc = json.loads(line)
     assert doc["failure_class"] == "backend_unavailable"
-    # on the TPU-configured (unprobed) backend the fused partition kernel
-    # prices the twolevel second pass under the straight sort at the bench
-    # union — the planner must still have run and picked a chip strategy
-    assert doc["planned_strategy"] == "incore_fused_twolevel"
+    # on the TPU-configured (unprobed) backend the radix-sort arm prices
+    # the narrow flat sort back under the twolevel second pass at the
+    # bench union — the planner must still have run and picked a chip
+    # strategy
+    assert doc["planned_strategy"] == "incore_fused_sort_narrow"
     assert doc["value"] == 0.0

@@ -110,6 +110,19 @@ class JoinConfig:
     #              runs it through the Pallas interpreter: CPU tier-1
     #              parity tests and host-mesh benches).
     partition_impl: str = "auto"
+    # Sort implementation behind every hot reorder (ops/sorting.py:
+    # merge_count presort, bucket build/probe, verify xor-fold, grouped
+    # codec — all inherit it with zero call-site edits):
+    #   "auto"   — Pallas LSD radix sort (ops/pallas/radix_sort.py) when
+    #              the backend compiles Mosaic, the lanes are 1-D uint32,
+    #              and the sort is big enough to amortize the digit
+    #              passes; else lax.sort (the degrade ticks SORTFALLBACK
+    #              once per process and logs once).
+    #   "xla"    — force lax.sort (the pre-kernel sort floor).
+    #   "pallas" / "pallas_interpret" — force the radix sort for every
+    #              eligible sort (interpret = the Pallas interpreter:
+    #              CPU tier-1 parity tests and host-mesh benches).
+    sort_impl: str = "auto"
 
     # --- policies --------------------------------------------------------------
     assignment_policy: str = "round_robin"   # or "load_aware"
@@ -221,6 +234,11 @@ class JoinConfig:
             raise ValueError(
                 f"unknown partition impl {self.partition_impl!r} (expected "
                 "'auto', 'sort', 'pallas', or 'pallas_interpret')")
+        if self.sort_impl not in ("auto", "xla", "pallas",
+                                  "pallas_interpret"):
+            raise ValueError(
+                f"unknown sort impl {self.sort_impl!r} (expected "
+                "'auto', 'xla', 'pallas', or 'pallas_interpret')")
         if self.max_retries < 0:
             raise ValueError("max_retries must be >= 0")
         if self.fallback not in ("none", "chunked"):

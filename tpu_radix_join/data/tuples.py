@@ -198,6 +198,30 @@ class WireSpec(NamedTuple):
         return self.bytes_per_block / self.capacity
 
 
+def effective_key_bits(key_bound: Optional[int], fanout_bits: int = 0,
+                       key_bits: int = 32) -> int:
+    """Bits a key can actually occupy given its (exclusive) upper bound.
+
+    ``key_bound`` is exclusive (keys < key_bound); ``None`` means the full
+    lane width.  ``fanout_bits`` are the partition-selector bits already
+    dropped by the caller (the wire codec shifts them out before packing).
+    This is the single source of truth for every bounds-aware width
+    decision: the packed exchange codec (``make_wire_spec``) sizes its
+    field widths from it, and the Pallas LSD radix sort
+    (ops/pallas/radix_sort.py) skips the digit passes it proves constant
+    — a 16-bit-bounded key needs 2 of the 4 uint32 passes.
+    """
+    if not 0 <= fanout_bits < key_bits:
+        raise ValueError(
+            f"fanout_bits must be in [0, {key_bits}), got {fanout_bits}")
+    if key_bound is None:
+        return key_bits - fanout_bits
+    if key_bound < 1:
+        raise ValueError(f"key_bound must be >= 1, got {key_bound}")
+    kb = max(1, ((int(key_bound) - 1) >> fanout_bits).bit_length())
+    return min(kb, key_bits - fanout_bits)
+
+
 def make_wire_spec(capacity: int, fanout_bits: int, wide: bool = False,
                    key_bound: Optional[int] = None,
                    rid_bound: Optional[int] = None) -> WireSpec:
@@ -209,16 +233,7 @@ def make_wire_spec(capacity: int, fanout_bits: int, wide: bool = False,
     if capacity < 1:
         raise ValueError(f"capacity must be >= 1, got {capacity}")
     key_bits = 64 if wide else 32
-    if not 0 <= fanout_bits < key_bits:
-        raise ValueError(
-            f"fanout_bits must be in [0, {key_bits}), got {fanout_bits}")
-    if key_bound is None:
-        kb = key_bits - fanout_bits
-    else:
-        if key_bound < 1:
-            raise ValueError(f"key_bound must be >= 1, got {key_bound}")
-        kb = max(1, ((int(key_bound) - 1) >> fanout_bits).bit_length())
-        kb = min(kb, key_bits - fanout_bits)
+    kb = effective_key_bits(key_bound, fanout_bits, key_bits)
     if rid_bound is None:
         rb = 32
     else:

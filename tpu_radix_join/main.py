@@ -90,6 +90,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "'sort' forces the sort-based scatter; 'pallas"
                         "_interpret' runs the kernel interpreted (CPU "
                         "parity/bench)")
+    p.add_argument("--sort-impl",
+                   choices=["auto", "xla", "pallas", "pallas_interpret"],
+                   default="auto",
+                   help="sort implementation behind every hot reorder "
+                        "(ops/sorting.py): 'auto' takes the Pallas LSD "
+                        "radix sort (ops/pallas/radix_sort.py) on a TPU "
+                        "backend for large 1-D uint32 sorts — fewer digit "
+                        "passes when key bounds shrink the effective "
+                        "width — else lax.sort (the degrade ticks "
+                        "SORTFALLBACK once per process and logs once); "
+                        "'xla' forces lax.sort; 'pallas_interpret' runs "
+                        "the kernel interpreted (CPU parity/bench)")
     p.add_argument("--cpu-fallback", action="store_true",
                    help="if device/mesh init fails, rebuild the engine over "
                         "host CPU devices (loud [DEGRADE] warning) instead "
@@ -618,6 +630,7 @@ def main(argv=None) -> int:
         exchange_codec=args.exchange_codec,
         exchange_stages=args.exchange_stages,
         partition_impl=args.partition_impl,
+        sort_impl=args.sort_impl,
     )
 
     meas = Measurements(node_id=jax.process_index(), num_nodes=nodes)

@@ -119,6 +119,14 @@ PARTFALLBACK = "PARTFALLBACK"  # partition/histogram auto-select fell back to
                            # the XLA sort path (Pallas unavailable or fanout
                            # past MAX_PARTITIONS) — the silent-degrade signal;
                            # more of these on a TPU backend is a regression
+SORTPASS = "SORTPASS"      # Pallas LSD radix sorts selected at trace time
+                           # (ops/sorting.py resolve_sort_impl); one per
+                           # traced sort site, like PARTPASS
+SORTFALLBACK = "SORTFALLBACK"  # sort auto-select degraded to lax.sort
+                           # (Pallas unavailable on this backend) — ticked
+                           # ONCE per process (the decision is per-process,
+                           # not per-sort) and paired with a log-once
+                           # stderr line; 1 on a TPU backend is a regression
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

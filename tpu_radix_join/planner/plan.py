@@ -18,17 +18,20 @@ from tpu_radix_join.planner.cost_model import (StrategyCost, Workload,
                                                enumerate_strategies,
                                                network_fanout_bits,
                                                pick_chunk_tuples,
-                                               plan_exchange)
+                                               plan_exchange, plan_sort,
+                                               wide_sort_factor)
 from tpu_radix_join.planner.profile import DeviceProfile
 
 # v2 adds ``grid_pipeline`` (the chunked engine's pipelined/synchronous
 # knob); v3 adds ``exchange_codec``/``exchange_stages`` (the bit-packed
 # wire codec and staged all_to_all); v4 adds ``predicted_terms`` (the
 # winning row's per-term ms breakdown, the predicted half of the
-# plan-vs-actual audit — planner/audit.py).  Older files load with the
+# plan-vs-actual audit — planner/audit.py); v5 adds ``sort_impl`` (the
+# sort-engine arm plan_sort priced for the winning row: the Pallas LSD
+# radix sort vs the XLA sort emitter).  Older files load with the
 # fields' defaults ("auto" pipeline, "off" codec, fused exchange, empty
-# term table).
-PLAN_SCHEMA_VERSION = 4
+# term table, "auto" sort).
+PLAN_SCHEMA_VERSION = 5
 
 
 class PlanError(ValueError):
@@ -56,6 +59,12 @@ class JoinPlan:
     grid_pipeline: str = "auto"          # chunked engine: "off"|"on"|"auto"
     exchange_codec: str = "off"          # wire codec: "off" | "pack"
     exchange_stages: int = 1             # 1 = fused all_to_all, k>1 staged
+    #: the sort-engine arm for the winning row's flat sorts
+    #: (cost_model.plan_sort): "pallas" binds the LSD radix kernel,
+    #: "xla" the lax.sort emitter, "auto" leaves the per-site runtime
+    #: select in charge (strategies whose sorts the 1-D kernel cannot
+    #: express anyway — batched bucket sorts, the chunked grid)
+    sort_impl: str = "auto"
     pipeline_repeats: bool = False
     strategy: str = ""
     predicted_ms: float = 0.0
@@ -113,6 +122,7 @@ class JoinPlan:
             "measure_phases": not self.fused,
             "exchange_codec": self.exchange_codec,
             "exchange_stages": self.exchange_stages,
+            "sort_impl": self.sort_impl,
         }
 
 
@@ -163,11 +173,23 @@ def plan_join(profile: DeviceProfile, workload: Workload
     else:
         # incore_{fused,split}_sort_{narrow,full}
         fused = "_fused_" in best.strategy
-        key_range = "full" if best.strategy.endswith("_full") else "narrow"
+        narrow = best.strategy.endswith("_narrow")
+        key_range = "narrow" if narrow else "full"
         if workload.key_bits == 64:
             key_range = "auto"     # wide keys have no range discipline
+        # re-price the winning row's sort with the same geometry
+        # enumerate_strategies used, and bind the chosen engine arm so
+        # the driver forces it instead of re-deciding per site
+        full_factor = (wide_sort_factor(profile) if workload.key_bits == 64
+                       else profile.value("full_range_sort_factor"))
+        splan = plan_sort(
+            profile, workload.union_per_node,
+            lanes=(1 if narrow else workload.lanes),
+            key_bound=(None if narrow else workload.key_bound),
+            key_bits=workload.key_bits,
+            lane_factor=(1.0 if narrow else full_factor))
         plan = JoinPlan(engine="incore", fused=fused, key_range=key_range,
-                        **kw)
+                        sort_impl=splan.impl, **kw)
         if not fused:
             # the split cannot pipeline (fence per program)
             plan = dataclasses.replace(plan, pipeline_repeats=False)
@@ -234,4 +256,10 @@ def explain_table(costs: List[StrategyCost],
                 f"stages={chosen.exchange_stages} "
                 f"({'fused' if chosen.exchange_stages <= 1 else 'staged'} "
                 f"all_to_all)")
+            lines.append(
+                f"sort: impl={chosen.sort_impl} "
+                + {"pallas": "(LSD radix kernel, ops/pallas/radix_sort.py)",
+                   "xla": "(lax.sort emitter)"}.get(
+                       chosen.sort_impl,
+                       "(runtime auto-select per sort site)"))
     return "\n".join(lines)

@@ -43,7 +43,14 @@ from typing import Dict, Optional
 # the kernel makes two passes over the ids and the lanes cross HBM twice).
 # v1-v3 profiles load through a shim deriving it from the cited hbm_gbps
 # (8 B of ids traffic per tuple per pass at streaming bandwidth).
-SCHEMA_VERSION = 4
+# v5 adds ``radix_sort_pass_unit_ms`` — ms per million tuples per DIGIT
+# pass of the Pallas LSD radix sort's slot kernel
+# (ops/pallas/radix_sort.py; per digit pass the kernel streams the key
+# lane twice and writes the slot permutation once; the per-lane scatters
+# are priced separately from hbm_gbps).  v1-v4 profiles load through a
+# shim deriving it as 12/hbm_gbps; calibrate.py re-fits it from
+# ``--sort-bench`` ledger rows with provenance.
+SCHEMA_VERSION = 5
 
 #: Constants the cost model reads.  Adding a term to cost_model.py means
 #: adding its constant here AND to every shipped profile, with a source tag
@@ -80,6 +87,15 @@ REQUIRED_CONSTANTS = (
     # to 8.0 / hbm_gbps at load (4 B read + 4 B written per tuple per pass
     # at the profile's streaming bandwidth).
     "partition_pass_unit_ms",
+    # Pallas LSD radix sort: ms per million tuples per digit pass of the
+    # slot kernel (cost_model.radix_sort_ms charges
+    # unit * Mtuples * passes + one per-lane scatter pass per digit; the
+    # pass count shrinks with the workload's key bound via
+    # data/tuples.effective_key_bits).  Schema v5; older profiles are
+    # shimmed to 12.0 / hbm_gbps at load (the kernel reads the 4 B key
+    # lane in both phases and writes 4 B of slots).  calibrate.py fits it
+    # from --sort-bench ledger rows (sort_kernel_ms / passes / Mtuples).
+    "radix_sort_pass_unit_ms",
 )
 
 #: Reference element count of the sort stage model's unit (PERF_NOTES
@@ -223,6 +239,19 @@ def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
             if isinstance(entry, dict) and entry.get("value"):
                 constants["partition_pass_unit_ms"] = {
                     "value": round(8.0 / float(entry["value"]), 5),
+                    "source": ("shim:derived from hbm_gbps "
+                               f"(schema v{version} profile; "
+                               f"{entry.get('source', 'uncited')})")}
+        if version < 5 and "radix_sort_pass_unit_ms" not in constants:
+            # schema v1-v4 shim: the radix-sort cost arm (schema v5) reads
+            # radix_sort_pass_unit_ms; derive it from the cited hbm_gbps —
+            # per digit pass the slot kernel streams the 4 B key lane in
+            # both grid phases and writes 4 B of slots, 12 B/tuple, so a
+            # million tuples cost 12/B ms at B GB/s.
+            entry = constants.get("hbm_gbps")
+            if isinstance(entry, dict) and entry.get("value"):
+                constants["radix_sort_pass_unit_ms"] = {
+                    "value": round(12.0 / float(entry["value"]), 5),
                     "source": ("shim:derived from hbm_gbps "
                                f"(schema v{version} profile; "
                                f"{entry.get('source', 'uncited')})")}
@@ -377,8 +406,15 @@ def calibrate(base: Optional[DeviceProfile] = None,
     dt = timed(jax.jit(lambda a: jax.lax.sort(a, is_stable=False)), keys)
     unit = dt * 1e3 / (sort_elems / SORT_REF_ELEMS) / sort_stage_units(
         sort_elems)
-    updates["sort_stage_unit_ms"] = {"value": round(unit, 5),
-                                     "source": "calibrate:flat_sort"}
+    # the citation RECORDS THE MEASURED IMPL: sort_stage_unit_ms models
+    # the XLA sort emitter specifically, and with the ops/sorting switch
+    # in play a probe that silently routed through the Pallas radix sort
+    # would cross-attribute radix passes to the stage model (and vice
+    # versa for a fitted radix_sort_pass_unit_ms).  lax.sort is called
+    # directly here — impl pinned, not resolved — and the tag says so.
+    updates["sort_stage_unit_ms"] = {
+        "value": round(unit, 5),
+        "source": "calibrate:flat_sort impl=xla(jax.lax.sort)"}
     # dispatch floor: the trivial-program round trip
     tiny = jnp.zeros((8,), jnp.uint32)
     fn = jax.jit(lambda a: a + jnp.uint32(1))
