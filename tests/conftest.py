@@ -18,3 +18,21 @@ os.environ.setdefault("TPU_RJ_GRID_FILE",
 from tpu_radix_join.utils.platform import force_host_cpu_devices
 
 force_host_cpu_devices(8, respect_existing=True)
+
+import pytest
+
+
+@pytest.fixture
+def transfer_guard():
+    """Arm ``jax.transfer_guard("disallow")`` for the test body: any
+    implicit device<->host transfer raises.  The runtime twin of
+    tools_lint.py's static sync-point rule — explicit readbacks through
+    ``utils.hostsync.host_readback`` (jax.device_get) stay legal, so a
+    test passing under this fixture proves the code path only syncs
+    where it says it does.  Build inputs BEFORE requesting the fixture
+    value's context (it is already armed when the test body runs), or
+    pre-place them with jax.device_put, which is likewise explicit."""
+    import jax
+
+    with jax.transfer_guard("disallow"):
+        yield

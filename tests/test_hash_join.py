@@ -163,3 +163,21 @@ def test_join_arrays_pipelined_matches_sync():
         assert hj.join_arrays(r, s).ok
     assert m.counters["MWINBYTES"] == m_sync.counters["MWINBYTES"]
     assert m.counters["MWINPUTCNT"] == m_sync.counters["MWINPUTCNT"]
+
+
+def test_join_clean_under_transfer_guard(transfer_guard):
+    """The whole engine path — placement and join — must run under
+    ``jax.transfer_guard("disallow")`` (the fixture arms it): every
+    device->host readback in the hot path goes through the explicit
+    ``utils.hostsync.host_readback`` (jax.device_get), so an implicit
+    sync anywhere raises here.  Runtime twin of tools_lint.py's static
+    sync-point rule — each catches what the other cannot (dynamic paths
+    vs. paths this workload doesn't execute)."""
+    cfg = JoinConfig(num_nodes=8, network_fanout_bits=5)
+    eng = HashJoin(cfg)
+    size = 1 << 15
+    rb = eng.place(Relation(size, 8, "unique", seed=1))
+    sb = eng.place(Relation(size, 8, "unique", seed=9))
+    res = eng.join_arrays(rb, sb)
+    assert res.ok
+    assert res.matches == size

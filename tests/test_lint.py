@@ -1,0 +1,349 @@
+"""graftlint framework tests: every rule gets a fire + pass fixture,
+the baseline round-trips (suppress / stale / reasonless-rejected), the
+CLI honors the 0/1/2 exit contract, and — the gate the rest exists for
+— the repo itself lints clean under --strict."""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from tpu_radix_join.analysis import (LintError, register_builtin_rules,
+                                     run_lint)
+
+register_builtin_rules()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(tmp_path, rel, code, rules, baseline=None):
+    """Lint one synthetic file at ``rel`` under a tmp repo root; returns
+    (findings-in-that-file, LintResult).  Filtering by path matters for
+    counter-tag, whose dead-pin direction reports against regress.py."""
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    res = run_lint(str(tmp_path), rule_ids=rules, baseline_path=baseline,
+                   paths=[str(path)])
+    return [f for f in res.findings if f.path == rel], res
+
+
+# ------------------------------------------------------------- sort-bypass
+def test_sort_bypass_fires_outside_sorting_module(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "import jax.numpy as jnp\n"
+                     "def f(x):\n"
+                     "    return jnp.argsort(x)\n",
+                     ["sort-bypass"])
+    assert [f.key for f in found] == ["jnp.argsort"]
+    assert found[0].line == 3
+    assert found[0].record() == "tpu_radix_join/foo.py:3:sort-bypass"
+
+
+def test_sort_bypass_allows_sorting_module_and_host_numpy(tmp_path):
+    # the switch's own home is the allowed site
+    found, _ = _lint(tmp_path, "tpu_radix_join/ops/sorting.py",
+                     "import jax.numpy as jnp\n"
+                     "def f(x):\n"
+                     "    return jnp.argsort(x)\n",
+                     ["sort-bypass"])
+    assert found == []
+    # host numpy is the oracle idiom, never flagged
+    found, _ = _lint(tmp_path, "tpu_radix_join/bar.py",
+                     "import numpy as np\n"
+                     "def f(x):\n"
+                     "    return np.argsort(x), np.sort(x), x.argsort()\n",
+                     ["sort-bypass"])
+    assert [f.key for f in found] == [".argsort()"]   # bare method: unknown
+    # receiver rooted at np stays allowed even spelled as a method
+    found, _ = _lint(tmp_path, "tpu_radix_join/baz.py",
+                     "import numpy as np\n"
+                     "def f(h):\n"
+                     "    return np.abs(h).argsort()\n",
+                     ["sort-bypass"])
+    assert found == []
+
+
+# ------------------------------------------------------------- counter-tag
+def test_counter_tag_fires_on_undeclared_tag(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "def f(m):\n"
+                     "    m.incr(\"TOTALLYNEWTAG\")\n",
+                     ["counter-tag"])
+    assert [f.key for f in found] == ["TOTALLYNEWTAG"]
+
+
+def test_counter_tag_passes_declared_and_neutral_tags(tmp_path):
+    # RTUPLES is explicitly neutral; JPROC matches a substring pattern;
+    # lower-case names are generic plumbing and skipped
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "def f(m, k):\n"
+                     "    m.incr(\"RTUPLES\", 4)\n"
+                     "    m.start(\"JPROC\")\n"
+                     "    m.stop(k)\n",
+                     ["counter-tag"])
+    assert found == []
+
+
+def test_counter_tag_reports_dead_pins(tmp_path):
+    # with the corpus reduced to one tag-free file, every exact pin is
+    # dead — the reverse direction of the cross-check
+    _, res = _lint(tmp_path, "tpu_radix_join/foo.py", "x = 1\n",
+                   ["counter-tag"])
+    dead = [f for f in res.findings
+            if f.path == "tpu_radix_join/observability/regress.py"]
+    assert dead, "dead-pin direction never fired"
+    assert any(f.key == "RTUPLES" for f in dead)
+
+
+# ----------------------------------------------------------- failure-class
+def test_failure_class_fires_on_handrolled_strings(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "def f(g):\n"
+                     "    g(failure_class=\"oom\")\n"
+                     "    d = {\"failure_class\": \"rank-lost\"}\n"
+                     "    d[\"failure_class\"] = \"boom\"\n",
+                     ["failure-class"])
+    assert sorted(f.key for f in found) == ["boom", "oom", "rank-lost"]
+
+
+def test_failure_class_passes_taxonomy_members(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "from tpu_radix_join.robustness.retry import RANK_LOST\n"
+                     "def f(g, cls):\n"
+                     "    g(failure_class=\"rank_lost\")\n"
+                     "    g(failure_class=\"unclassified\")\n"
+                     "    g(failure_class=RANK_LOST)\n"   # names not checked
+                     "    g(failure_class=cls)\n",
+                     ["failure-class"])
+    assert found == []
+
+
+# -------------------------------------------------------------- sync-point
+def test_sync_point_fires_on_implicit_syncs(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/ops/chunked.py",
+                     "import numpy as np\n"
+                     "import jax.numpy as jnp\n"
+                     "def f(x):\n"
+                     "    a = x.item()\n"
+                     "    b = int(jnp.max(x))\n"
+                     "    c = np.asarray(x)\n"
+                     "    return a, b, c\n",
+                     ["sync-point"])
+    assert sorted(f.key for f in found) == [".item()", "int(jnp.max)",
+                                            "np.asarray"]
+
+
+def test_sync_point_passes_explicit_and_host_spellings(tmp_path):
+    # host_readback is the sanctioned spelling; literal-list asarray is
+    # host array building; asarray outside the hot files is unscoped
+    found, _ = _lint(tmp_path, "tpu_radix_join/ops/chunked.py",
+                     "import numpy as np\n"
+                     "from tpu_radix_join.utils.hostsync import "
+                     "host_readback\n"
+                     "def f(x, n):\n"
+                     "    a = int(host_readback(x))\n"
+                     "    b = np.asarray([n, n + 1], np.uint32)\n"
+                     "    return a, b\n",
+                     ["sync-point"])
+    assert found == []
+    found, _ = _lint(tmp_path, "tpu_radix_join/planner/cold.py",
+                     "import numpy as np\n"
+                     "def f(x):\n"
+                     "    return np.asarray(x)\n",     # not a hot file
+                     ["sync-point"])
+    assert found == []
+
+
+# -------------------------------------------------------- recompile-hazard
+def test_recompile_hazard_fires(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "import jax, functools\n"
+                     "def f(xs, g, n):\n"
+                     "    for x in xs:\n"
+                     "        jax.jit(g)(x)\n"
+                     "    self_key = None\n"
+                     "    h = jax.jit(g, static_argnums=tuple(range(n)))\n"
+                     "    return h\n"
+                     "def k(self, g, cap):\n"
+                     "    return self._compile_timed(f\"cap={cap}\", g)\n",
+                     ["recompile-hazard"])
+    assert sorted(f.key for f in found) == ["dynamic-static_argnums",
+                                            "fstring-compile-key",
+                                            "jit-in-loop"]
+
+
+def test_recompile_hazard_passes_hoisted_and_literal(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "import jax\n"
+                     "def f(xs, g):\n"
+                     "    h = jax.jit(g, static_argnums=(0, 1))\n"
+                     "    for x in xs:\n"
+                     "        h(x)\n"
+                     "    return h\n"
+                     "def k(self, g, cap):\n"
+                     "    return self._compile_timed((\"probe\", cap), g)\n",
+                     ["recompile-hazard"])
+    assert found == []
+
+
+# --------------------------------------------------------- lock-discipline
+_THREADED = """
+    import threading
+
+    class Worker:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.count = 0
+
+        def start(self):
+            self._t = threading.Thread(target=self._loop)
+            self._t.start()
+
+        def _loop(self):
+            {body}
+"""
+
+
+def test_lock_discipline_fires_on_unguarded_thread_write(tmp_path):
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     _THREADED.format(body="self.count += 1"),
+                     ["lock-discipline"])
+    assert [f.key for f in found] == ["Worker._loop:self.count"]
+
+
+def test_lock_discipline_passes_guarded_write(tmp_path):
+    found, _ = _lint(
+        tmp_path, "tpu_radix_join/foo.py",
+        _THREADED.format(body="with self._lock:\n"
+                              "                self.count += 1"),
+        ["lock-discipline"])
+    assert found == []
+
+
+def test_lock_discipline_follows_self_call_closure(tmp_path):
+    # the write hides one self-call away from the thread target
+    code = _THREADED.format(body="self._step()") + (
+        "\n        def _step(self):\n"
+        "            self.count += 1\n")
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py", code,
+                     ["lock-discipline"])
+    assert [f.key for f in found] == ["Worker._step:self.count"]
+
+
+# ---------------------------------------------------------- inline waivers
+def test_waiver_needs_a_reason(tmp_path):
+    waived = _THREADED.format(
+        body="self.count += 1  # lint: unguarded-ok(one-shot flag; "
+             "readers join first)")
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py", waived,
+                     ["lock-discipline"])
+    assert found == []
+    bare = _THREADED.format(body="self.count += 1  # lint: unguarded-ok()")
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py", bare,
+                     ["lock-discipline"])
+    assert len(found) == 1, "a reasonless waiver must suppress nothing"
+
+
+def test_waiver_token_is_rule_specific(tmp_path):
+    # a sync waiver does not silence the sort rule
+    found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",
+                     "import jax.numpy as jnp\n"
+                     "def f(x):\n"
+                     "    return jnp.argsort(x)  # lint: sync-ok(nope)\n",
+                     ["sort-bypass"])
+    assert len(found) == 1
+
+
+# ---------------------------------------------------------------- baseline
+def _baseline(tmp_path, entries):
+    p = tmp_path / "LINT_BASELINE.json"
+    p.write_text(json.dumps({"suppressions": entries}))
+    return str(p)
+
+
+def test_baseline_suppresses_matching_finding(tmp_path):
+    bl = _baseline(tmp_path, [{
+        "rule": "sort-bypass", "path": "tpu_radix_join/foo.py",
+        "key": "jnp.argsort", "reason": "fixture keep"}])
+    found, res = _lint(tmp_path, "tpu_radix_join/foo.py",
+                       "import jax.numpy as jnp\n"
+                       "def f(x):\n"
+                       "    return jnp.argsort(x)\n",
+                       ["sort-bypass"], baseline=bl)
+    assert found == []
+    assert len(res.suppressed) == 1 and not res.stale
+    assert res.exit_code(strict=True) == 0
+
+
+def test_baseline_stale_entry_fails_only_under_strict(tmp_path):
+    bl = _baseline(tmp_path, [{
+        "rule": "sort-bypass", "path": "tpu_radix_join/gone.py",
+        "key": "jnp.sort", "reason": "the finding was fixed"}])
+    found, res = _lint(tmp_path, "tpu_radix_join/foo.py", "x = 1\n",
+                       ["sort-bypass"], baseline=bl)
+    assert found == [] and len(res.stale) == 1
+    assert res.exit_code(strict=False) == 0
+    assert res.exit_code(strict=True) == 1
+
+
+def test_baseline_stale_check_ignores_rules_that_did_not_run(tmp_path):
+    # a sort suppression cannot be judged stale by a sync-only run
+    bl = _baseline(tmp_path, [{
+        "rule": "sort-bypass", "path": "tpu_radix_join/gone.py",
+        "key": "jnp.sort", "reason": "judged only when sort runs"}])
+    _, res = _lint(tmp_path, "tpu_radix_join/foo.py", "x = 1\n",
+                   ["sync-point"], baseline=bl)
+    assert res.stale == []
+
+
+def test_baseline_reasonless_entry_is_a_load_error(tmp_path):
+    bl = _baseline(tmp_path, [{
+        "rule": "sort-bypass", "path": "tpu_radix_join/foo.py",
+        "key": "jnp.argsort", "reason": "   "}])
+    with pytest.raises(LintError):
+        _lint(tmp_path, "tpu_radix_join/foo.py", "x = 1\n",
+              ["sort-bypass"], baseline=bl)
+
+
+def test_unknown_rule_id_is_a_lint_error(tmp_path):
+    with pytest.raises(LintError):
+        run_lint(str(tmp_path), rule_ids=["no-such-rule"], paths=[])
+
+
+# ------------------------------------------------------------ CLI contract
+def test_cli_exit_codes(tmp_path, capsys):
+    import tools_lint
+
+    # 0: the repo's own gating invocation
+    assert tools_lint.main(["--strict"]) == 0
+    # 1: without the baseline the two deliberate sort keeps are live
+    assert tools_lint.main(["--no-baseline", "--rule", "sort-bypass"]) == 1
+    # 2: usage errors — unknown rule, missing explicit baseline
+    assert tools_lint.main(["--rule", "no-such-rule"]) == 2
+    assert tools_lint.main(
+        ["--baseline", str(tmp_path / "missing.json")]) == 2
+    capsys.readouterr()
+    # --json writes the regress-gateable counters
+    out = tmp_path / "lint.json"
+    assert tools_lint.main(["--strict", "--json", str(out)]) == 0
+    data = json.loads(out.read_text())
+    assert data["lint_findings"] == 0
+    assert data["stale_baseline"] == 0
+    assert data["suppressed"] >= 2
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------- self-clean
+def test_repo_is_lint_clean():
+    """The tier-1 gate: every rule over the real tree, baseline applied,
+    strict — any new convention violation fails here with its
+    path:line:rule record in the assertion message."""
+    res = run_lint(REPO_ROOT,
+                   baseline_path=os.path.join(REPO_ROOT,
+                                              "LINT_BASELINE.json"))
+    assert not res.findings, "\n".join(f.render() for f in res.findings)
+    assert not res.stale, (
+        "stale baseline suppressions (fixed findings must take their "
+        f"entries with them): {res.stale}")

@@ -97,6 +97,15 @@ where it reaches the partition count, i.e. a veiled restart),
 pinned lower-is-better: a fleet that starts losing more ranks or
 fencing more epochs per round regresses even when each individual
 recovery still lands oracle-exact.
+
+The static-analysis counters gate the same way: ``lint_findings`` and
+``stale_baseline`` (``tools_lint.py --json`` — live graftlint findings
+and baseline suppressions whose finding was already fixed) are pinned
+lower-is-better, so a convention regression (a stray direct sort, an
+unpinned counter tag, an implicit hot-path host sync) fails this gate
+exactly like a perf regression.  The lint rules themselves, their
+baseline discipline, and the ``--transfer-guard`` runtime twin are
+documented in tools_lint.py.
 """
 
 import argparse

@@ -1,0 +1,43 @@
+"""graftlint: project-native static analysis for tpu_radix_join.
+
+The framework lives in :mod:`core` (registry, walker, findings,
+baseline); the six convention rules each get a module:
+
+  =================  ===================================================
+  sort-bypass        hot sorts route through ops/sorting.py (PR 12)
+  counter-tag        emitted tags pinned/neutral in regress.py, both ways
+  failure-class      failure_class strings come from the retry taxonomy
+  sync-point         no implicit host syncs in engine hot paths
+  recompile-hazard   no jit-in-loop / f-string compile keys
+  lock-discipline    thread-target writes hold a lock or say why not
+  =================  ===================================================
+
+CLI: ``tools_lint.py`` at the repo root; tier-1 gate:
+``tests/test_lint.py::test_repo_is_lint_clean``.
+"""
+
+from tpu_radix_join.analysis.core import (BASELINE_NAME, Finding, LintError,
+                                          LintResult, RULES, Repo,
+                                          apply_baseline, load_baseline,
+                                          load_repo, run_lint)
+
+_REGISTERED = False
+
+
+def register_builtin_rules() -> None:
+    """Import the rule modules (idempotent): importing registers."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from tpu_radix_join.analysis import (rules_failure,     # noqa: F401
+                                         rules_locks,       # noqa: F401
+                                         rules_recompile,   # noqa: F401
+                                         rules_sort,        # noqa: F401
+                                         rules_sync,        # noqa: F401
+                                         rules_tags)        # noqa: F401
+    _REGISTERED = True
+
+
+__all__ = ["BASELINE_NAME", "Finding", "LintError", "LintResult", "RULES",
+           "Repo", "apply_baseline", "load_baseline", "load_repo",
+           "run_lint", "register_builtin_rules"]

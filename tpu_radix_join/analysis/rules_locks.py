@@ -1,0 +1,158 @@
+"""Rule ``lock-discipline``: thread-target writes happen under a lock.
+
+Five daemon threads share state with the main thread — the hang
+watchdog, the MetricsSampler, the AsyncCheckpointWriter, the grid
+prefetcher, and the lease heartbeat (which rides the sampler's tick via
+``LeaseBoard.sampler_extra``).  Their informal rule has been "writes
+from the thread side hold the instance lock"; this rule makes it
+checkable:
+
+  * a **thread-target method** is any method a class passes to
+    ``threading.Thread(target=self.<m>)``, plus the closure of
+    ``self.<m>()`` calls reachable from it inside the same class, plus
+    the :data:`EXTRA_THREAD_METHODS` entries (methods that run on
+    *another* class's thread — the lease heartbeat runs on the
+    metrics-sampler tick);
+  * inside that closure, every ``self.<attr> = ...`` (plain, augmented,
+    annotated, or tuple-unpacked) must sit lexically inside a ``with``
+    whose context expression names a lock (``lock``/``cond``/``mutex``
+    in its spelling — ``with self._lock:``, ``with self._cond:``), or
+    carry ``# lint: unguarded-ok(<reason>)``.
+
+The rule is deliberately lexical: it cannot prove a caller holds the
+lock for you (use an RLock and re-enter, the metrics.py idiom), and it
+does not chase writes through container mutation — rebinding instance
+attributes is the race the repo's threads actually share state through.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from tpu_radix_join.analysis.core import (Finding, Repo, dotted_name,
+                                          is_self_attr, rule)
+
+#: (class, method) pairs that execute on another class's thread: the
+#: lease heartbeat is invoked from the MetricsSampler daemon tick (via
+#: LeaseBoard.sampler_extra) *and* from the main thread's join loop
+EXTRA_THREAD_METHODS = {("LeaseBoard", "heartbeat")}
+
+LOCK_HINTS = ("lock", "cond", "mutex")
+
+
+def _is_thread_ctor(node: ast.Call) -> bool:
+    name = dotted_name(node.func)
+    return name is not None and name.split(".")[-1] == "Thread"
+
+
+def _thread_targets(cls: ast.ClassDef) -> Set[str]:
+    """Method names the class hands to threading.Thread(target=...)."""
+    out: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Call) and _is_thread_ctor(node):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = is_self_attr(kw.value)
+                    if attr is not None:
+                        out.add(attr)
+    return out
+
+
+def _closure(cls: ast.ClassDef, roots: Set[str]) -> Set[str]:
+    """Transitive closure of self.<m>() calls from the root methods."""
+    methods: Dict[str, ast.FunctionDef] = {
+        n.name: n for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    seen: Set[str] = set()
+    frontier = [m for m in roots if m in methods]
+    while frontier:
+        m = frontier.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        for node in ast.walk(methods[m]):
+            if isinstance(node, ast.Call):
+                callee = is_self_attr(node.func)
+                if callee in methods and callee not in seen:
+                    frontier.append(callee)
+    return seen
+
+
+def _locked_with(node: ast.With) -> bool:
+    for item in node.items:
+        spelled = ast.unparse(item.context_expr).lower()
+        if any(h in spelled for h in LOCK_HINTS):
+            return True
+    return False
+
+
+class _WriteScan(ast.NodeVisitor):
+    """Collect self-attribute writes not lexically under a lock With."""
+
+    def __init__(self):
+        self.depth = 0
+        self.writes: List[tuple] = []        # (line, attr)
+
+    def visit_With(self, node: ast.With):
+        locked = _locked_with(node)
+        if locked:
+            self.depth += 1
+        self.generic_visit(node)
+        if locked:
+            self.depth -= 1
+
+    def _check_target(self, tgt: ast.AST, line: int):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                self._check_target(e, line)
+            return
+        attr = is_self_attr(tgt)
+        if attr is not None and self.depth == 0:
+            self.writes.append((line, attr))
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._check_target(tgt, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.value is not None:
+            self._check_target(node.target, node.lineno)
+        self.generic_visit(node)
+
+
+@rule("lock-discipline",
+      "attribute writes in background-thread methods must hold a lock "
+      "or carry # lint: unguarded-ok(reason)",
+      token="unguarded")
+def check(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        for cls in ast.walk(src.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            roots = _thread_targets(cls)
+            roots |= {m for c, m in EXTRA_THREAD_METHODS if c == cls.name}
+            if not roots:
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            for mname in sorted(_closure(cls, roots)):
+                scan = _WriteScan()
+                scan.visit(methods[mname])
+                for line, attr in scan.writes:
+                    out.append(Finding(
+                        rule="lock-discipline", path=src.rel, line=line,
+                        key=f"{cls.name}.{mname}:self.{attr}",
+                        message=(f"self.{attr} written in thread-target "
+                                 f"method {cls.name}.{mname} without a "
+                                 f"held lock — guard it (with "
+                                 f"self._lock:) or annotate "
+                                 f"unguarded-ok with why it is safe")))
+    return out

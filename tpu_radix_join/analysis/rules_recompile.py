@@ -1,0 +1,107 @@
+"""Rule ``recompile-hazard``: compile keys and jit sites that churn.
+
+The compile-storm monitor (PR 9, observability/compilemon.py) catches a
+recompiling serve session *after* it burns wall time; the hazards it
+sees are statically visible:
+
+  * **jit in a loop** — ``jax.jit``/``jax.pmap`` called inside a
+    ``for``/``while`` body builds a fresh callable (and cache entry)
+    per iteration; the trace cache keys on the new wrapper, so every
+    pass recompiles.  Hoist the jit or cache the wrapped callable.
+  * **f-string compile keys** — an ``ast.JoinedStr`` inside the key
+    tuple passed to the engine's ``_compile_timed`` (or directly among
+    a ``jax.jit`` call's arguments) bakes interpolated values — floats,
+    object reprs with addresses — into the cache key: unbounded key
+    cardinality, one compile per distinct repr.  Keys must be tuples of
+    hashable *semantic* values (the fingerprinted-path discipline of
+    planner/cache.py, which hashes a canonical JSON dump instead).
+  * **dynamic static specs** — ``static_argnums=``/``static_argnames=``
+    built from a runtime expression rather than a literal: the spec
+    silently varies per construction site, and two sites that look
+    identical compile twice.
+
+Deliberate sites (a calibration probe that *measures* compiles) carry
+``# lint: recompile-ok(<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tpu_radix_join.analysis.core import Finding, Repo, dotted_name, rule
+
+JIT_CALLS = {"jax.jit", "jax.pmap"}
+#: the engine's fingerprinted compile-cache entry point: its key tuples
+#: are the compile keys this rule audits
+COMPILE_KEY_FUNCS = {"_compile_timed", "self._compile_timed"}
+STATIC_KWARGS = {"static_argnums", "static_argnames"}
+
+
+def _literal_spec(node: ast.AST) -> bool:
+    """True for the hashable literal spellings of a static spec."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(isinstance(e, ast.Constant) for e in node.elts)
+    return False
+
+
+def _contains_fstring(node: ast.AST) -> bool:
+    return any(isinstance(n, ast.JoinedStr) for n in ast.walk(node))
+
+
+@rule("recompile-hazard",
+      "jit-in-loop, f-string compile keys, and dynamic static_arg "
+      "specs cause silent recompile churn",
+      token="recompile")
+def check(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        loop_jits = set()
+        for loop in ast.walk(src.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and dotted_name(node.func) in JIT_CALLS
+                        and node.lineno not in loop_jits):
+                    loop_jits.add(node.lineno)
+                    out.append(Finding(
+                        rule="recompile-hazard", path=src.rel,
+                        line=node.lineno, key="jit-in-loop",
+                        message=(f"{dotted_name(node.func)} inside a "
+                                 f"loop body retraces every iteration — "
+                                 f"hoist the jit out of the loop or "
+                                 f"cache the wrapped callable")))
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in JIT_CALLS or (name or "").endswith("_compile_timed"):
+                for arg in list(node.args) + [kw.value
+                                              for kw in node.keywords]:
+                    if _contains_fstring(arg):
+                        out.append(Finding(
+                            rule="recompile-hazard", path=src.rel,
+                            line=node.lineno, key="fstring-compile-key",
+                            message=("f-string inside a compile key / "
+                                     "jit argument bakes interpolated "
+                                     "reprs into the cache key — build "
+                                     "keys from hashable semantic "
+                                     "values")))
+                        break
+            if name in JIT_CALLS or (
+                    name == "functools.partial" and node.args
+                    and dotted_name(node.args[0]) in JIT_CALLS):
+                for kw in node.keywords:
+                    if (kw.arg in STATIC_KWARGS
+                            and not _literal_spec(kw.value)):
+                        out.append(Finding(
+                            rule="recompile-hazard", path=src.rel,
+                            line=node.lineno, key=f"dynamic-{kw.arg}",
+                            message=(f"{kw.arg} built from a runtime "
+                                     f"expression — use a literal "
+                                     f"tuple so the static spec cannot "
+                                     f"drift between sites")))
+    return out

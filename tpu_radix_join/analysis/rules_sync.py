@@ -1,0 +1,114 @@
+"""Rule ``sync-point``: no implicit host syncs in the engine hot paths.
+
+Every ``np.asarray(device_array)``, ``int(jnp_scalar)``, or ``.item()``
+is a blocking device→host round trip, and the *implicit* spellings are
+invisible at review: an accidental one (reading a value the program
+never needed on host) reads exactly like a load-bearing one.  The
+sanctioned spelling is ``utils.hostsync.host_readback`` — an explicit
+``jax.device_get`` that stays legal under
+``jax.transfer_guard("disallow")``, so this static rule and the runtime
+guard (``main.py --transfer-guard``, the tests' ``transfer_guard``
+fixture) witness each other: code the rule passes runs clean under the
+guard, and a guard trip points at a spelling the rule missed.
+
+Three checks:
+
+  * ``.item()`` — flagged everywhere in the package (there is no
+    host-side use of ``.item()`` in this codebase's idiom);
+  * ``int(...)``/``float(...)``/``bool(...)`` whose direct argument is
+    an ``np.asarray``/``jnp.*``/``jax.*`` call — a scalar readback that
+    blocks on device completion, flagged everywhere in the package;
+  * any non-literal ``np.asarray(...)`` inside the **hot files**
+    (``ops/chunked.py``, ``operators/hash_join.py`` — the two modules
+    that drive device programs mid-join); literal list/tuple arguments
+    are host-side array building and stay allowed.
+
+A deliberate implicit sync can carry ``# lint: sync-ok(<reason>)``, but
+``host_readback`` is the preferred fix: it is greppable, explicit, and
+guard-clean.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tpu_radix_join.analysis.core import Finding, Repo, dotted_name, rule
+
+#: modules that drive device programs mid-join: every np.asarray here is
+#: a device readback until proven otherwise
+HOT_FILES = {
+    "tpu_radix_join/ops/chunked.py",
+    "tpu_radix_join/operators/hash_join.py",
+}
+#: the sanctioned helper's home (np.asarray there IS the implementation)
+EXEMPT_FILES = {"tpu_radix_join/utils/hostsync.py"}
+
+SCALAR_CASTS = {"int", "float", "bool"}
+DEVICE_ROOTS = {"jnp", "jax"}
+
+
+def _is_asarray(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in ("np.asarray", "numpy.asarray"))
+
+
+def _is_device_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    name = dotted_name(node.func)
+    return name is not None and name.split(".")[0] in DEVICE_ROOTS
+
+
+def _literal_arg(node: ast.Call) -> bool:
+    """A literal list/tuple/constant first argument is host-side array
+    building, not a readback — with or without a dtype argument."""
+    if not node.args:
+        return False
+    a = node.args[0]
+    return isinstance(a, (ast.List, ast.Tuple, ast.Constant))
+
+
+@rule("sync-point",
+      "implicit host syncs (.item(), int(jnp...), np.asarray in hot "
+      "paths) must go through utils.hostsync.host_readback",
+      token="sync")
+def check(repo: Repo) -> List[Finding]:
+    out: List[Finding] = []
+    for src in repo.files:
+        if src.rel in EXEMPT_FILES:
+            continue
+        hot = src.rel in HOT_FILES
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "item" and not node.args):
+                out.append(Finding(
+                    rule="sync-point", path=src.rel, line=node.lineno,
+                    key=".item()",
+                    message=(".item() is an implicit blocking device "
+                             "readback — use int(host_readback(...)) "
+                             "(utils/hostsync.py)")))
+                continue
+            fname = dotted_name(node.func)
+            if (fname in SCALAR_CASTS and len(node.args) == 1
+                    and (_is_asarray(node.args[0])
+                         or _is_device_call(node.args[0]))):
+                inner = dotted_name(node.args[0].func)
+                out.append(Finding(
+                    rule="sync-point", path=src.rel, line=node.lineno,
+                    key=f"{fname}({inner})",
+                    message=(f"{fname}({inner}(...)) is an implicit "
+                             f"scalar sync — spell it "
+                             f"{fname}(host_readback(...))")))
+                continue
+            if hot and _is_asarray(node) and not _literal_arg(node):
+                out.append(Finding(
+                    rule="sync-point", path=src.rel, line=node.lineno,
+                    key="np.asarray",
+                    message=("np.asarray in an engine hot path is an "
+                             "implicit device→host transfer — use "
+                             "host_readback (explicit, transfer-guard-"
+                             "clean) or annotate sync-ok with a reason")))
+    return out

@@ -133,6 +133,15 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--debug-checks", action="store_true",
                    help="per-partition conservation invariants "
                         "(JOIN_ASSERT analog; extra passes)")
+    p.add_argument("--transfer-guard", choices=["off", "log", "disallow"],
+                   default="off",
+                   help="arm jax.transfer_guard around the join: 'log' "
+                        "prints every implicit device<->host transfer, "
+                        "'disallow' raises on one — the runtime twin of "
+                        "tools_lint.py's static sync-point rule (explicit "
+                        "utils.hostsync.host_readback stays legal under "
+                        "both; data generation/placement is outside the "
+                        "guard, matching the reference timing bracket)")
     p.add_argument("--measure-phases", action="store_true",
                    help="run shuffle and probe as separate programs so "
                         ".perf carries JMPI and JPROC columns (costs the "
@@ -893,9 +902,16 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     death_ctx = (_faults.FaultInjector(seed=args.seed, measurements=meas)
                  .arm(_faults.RANK_DEATH, at=args.rank_death_at)
                  if args.rank_death_at else contextlib.nullcontext())
+    # --transfer-guard: the runtime half of the sync-point discipline —
+    # the static rule (tools_lint.py) forbids implicit readback spellings;
+    # this guard proves at run time that none slipped through a dynamic
+    # path.  Armed around the join only: generation + placement transfer
+    # by design (the reference pays them outside its timers too).
+    tg_ctx = (jax.transfer_guard(args.transfer_guard)
+              if args.transfer_guard != "off" else contextlib.nullcontext())
     times0 = phase_snapshot(meas)
     try:
-        with trace_ctx, wd_ctx, death_ctx:
+        with trace_ctx, wd_ctx, death_ctx, tg_ctx:
             if args.pipeline_repeats and args.repeat > 1:
                 result = engine.join_arrays_pipelined(r_batch, s_batch,
                                                       args.repeat)

@@ -88,6 +88,11 @@ class MetricsSampler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._file = None
+        # sample() runs on the daemon tick AND on the main thread
+        # (start's first line, stop's final line — which races a
+        # straggler tick if the 5s join times out); reentrant so
+        # _rotate can re-enter from inside a locked sample()
+        self._lock = threading.RLock()
         m = measurements
         self._epoch0 = (float(m.meta["epoch_s"])
                         if m is not None and "epoch_s" in m.meta
@@ -135,36 +140,38 @@ class MetricsSampler:
     def sample(self) -> dict:
         """Take and persist one sample (also called by the thread loop)."""
         rec = self._record()
-        f = self._file
-        if f is not None:
-            f.write(json.dumps(rec) + "\n")
-            f.flush()
-            self.samples_written += 1
-            try:
-                if f.tell() >= self.rotate_bytes:
-                    self._rotate()
-            except Exception:   # rotation failure must never kill the join
-                pass
+        with self._lock:
+            f = self._file
+            if f is not None:
+                f.write(json.dumps(rec) + "\n")
+                f.flush()
+                self.samples_written += 1
+                try:
+                    if f.tell() >= self.rotate_bytes:
+                        self._rotate()
+                except Exception:   # rotation must never kill the join
+                    pass
         return rec
 
     def _rotate(self) -> None:
         """Size-cap rotation: live file -> .1, .k -> .(k+1), the rotation
         past ``rotate_keep`` dropped; sampling continues into a fresh live
         file.  tail -f keeps following the live path (the fd reopens)."""
-        f, self._file = self._file, None
-        if f is not None:
-            f.close()
-        oldest = f"{self.path}.{self.rotate_keep}"
-        if os.path.exists(oldest):
-            os.remove(oldest)
-        for k in range(self.rotate_keep - 1, 0, -1):
-            src = f"{self.path}.{k}"
-            if os.path.exists(src):
-                os.replace(src, f"{self.path}.{k + 1}")
-        if os.path.exists(self.path):
-            os.replace(self.path, f"{self.path}.1")
-        self._file = open(self.path, "a")
-        self.rotations += 1
+        with self._lock:
+            f, self._file = self._file, None
+            if f is not None:
+                f.close()
+            oldest = f"{self.path}.{self.rotate_keep}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for k in range(self.rotate_keep - 1, 0, -1):
+                src = f"{self.path}.{k}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{k + 1}")
+            if os.path.exists(self.path):
+                os.replace(self.path, f"{self.path}.1")
+            self._file = open(self.path, "a")
+            self.rotations += 1
 
     # -------------------------------------------------------------- lifecycle
     def start(self) -> "MetricsSampler":
@@ -194,9 +201,12 @@ class MetricsSampler:
         try:
             self.sample()                   # final state at shutdown
         finally:
-            f, self._file = self._file, None
-            if f is not None:
-                f.close()
+            # under the lock: a straggler tick (join timed out above)
+            # must not write into a closing fd
+            with self._lock:
+                f, self._file = self._file, None
+                if f is not None:
+                    f.close()
 
     def __enter__(self) -> "MetricsSampler":
         return self.start()

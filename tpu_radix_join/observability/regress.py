@@ -28,7 +28,10 @@ DEFAULT_THRESHOLD = 0.25       # bench timings through a shared tunnel are
                                # noisy; per-tag overrides tighten hot tags
 
 # tags where larger is better (everything else is treated as a cost)
-_HIGHER_BETTER = {"value", "vs_baseline"}
+_HIGHER_BETTER = {"value", "vs_baseline",
+                  # warm queries are capacity-cache hits: fewer means the
+                  # resident session stopped amortizing its sizing passes
+                  "QWARM"}
 _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
                              "tuples_per_sec", "per_sec", "pairs/sec",
                              "speedup",
@@ -99,7 +102,40 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # epoch per round are all strictly worse — a
                             # healthy fleet holds MEPOCH at 0
                             "ranklost", "recover_ms", "recoverms",
-                            "recovern", "mepoch", "restart_ms")
+                            "recovern", "mepoch", "restart_ms",
+                            # static-analysis gate (tools_lint.py --json):
+                            # more live lint findings is strictly worse —
+                            # a finding-count regression gates like a perf
+                            # regression
+                            "lint_findings", "stale_baseline")
+# Exact-name lower-is-better pins for the Measurements counter/timer
+# vocabulary (performance/measurements.py).  Historically these rode the
+# "unmatched tags default to cost" rule; the counter-tag lint rule
+# (analysis/rules_tags.py) now requires every emitted tag to be
+# *declared* — pinned here, in _HIGHER_BETTER, or explicitly neutral —
+# so the default never decides a gate silently.  Phase walls and waits
+# are times; retry/backoff, rejection/deadline/degrade verdicts, breaker
+# trips, verification failures/repairs, per-trace pass selections, and
+# the wire-byte/pack-ratio gauges all regress when they GROW.
+_COST_TAGS = {"JTOTAL", "JPROC", "JHIST", "JMPI", "JCOMPILE", "SWINALLOC",
+              "SNETCOMPL", "SLOCPREP", "MWINWAIT", "SDISPATCH", "CTOTAL",
+              "BPBUILD", "BPPROBE", "VCHK",
+              "RETRYN", "BACKOFFMS", "RETRIES",
+              "QREJECT", "QDEADLINE", "QDEGRADED", "BRKTRIP",
+              "VFAIL", "VREPAIR",
+              "PARTPASS", "SORTPASS",
+              "MWINBYTES", "PACKRATIO"}
+# Explicitly neutral tags: workload/geometry descriptors with no
+# regression direction (tuple counts scale with the input, capacities
+# and stage counts describe the plan, chaos/checkpoint counters describe
+# the scenario).  Declared so the counter-tag rule can tell "decided
+# neutral" from "nobody looked"; when one shows up in a baseline diff it
+# is still compared under the conservative cost default.
+NEUTRAL_TAGS = {"RTUPLES", "STUPLES", "RESULTS",
+                "MWINPUTCNT", "WINCAPR", "WINCAPS", "XSTAGES",
+                "BPBUILDTUPLES", "BPPROBETUPLES",
+                "VCHKN", "QADMIT", "BRKPROBE",
+                "FINJECT", "CKPTSAVE", "CKPTLOAD", "GRIDPAIRS"}
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          "schema_version"}
@@ -107,9 +143,22 @@ _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
 
 def higher_is_better(tag: str) -> bool:
     t = tag.lower()
-    if any(s in t for s in _LOWER_BETTER_SUBSTRINGS):
+    if tag in _COST_TAGS or any(s in t for s in _LOWER_BETTER_SUBSTRINGS):
         return False
     return (tag in _HIGHER_BETTER
+            or any(s in t for s in _HIGHER_BETTER_SUBSTRINGS))
+
+
+def tag_is_declared(tag: str) -> bool:
+    """True when the tag's gate direction was *decided*: an exact pin
+    (_HIGHER_BETTER / _COST_TAGS / NEUTRAL_TAGS / _SKIP) or a substring
+    match in either direction list.  The counter-tag lint rule
+    (analysis/rules_tags.py) fails any emitted tag for which this is
+    False — the implicit cost default must never decide a gate."""
+    t = tag.lower()
+    return (tag in _HIGHER_BETTER or tag in _COST_TAGS
+            or tag in NEUTRAL_TAGS or tag in _SKIP
+            or any(s in t for s in _LOWER_BETTER_SUBSTRINGS)
             or any(s in t for s in _HIGHER_BETTER_SUBSTRINGS))
 
 

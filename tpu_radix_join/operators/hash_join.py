@@ -83,6 +83,7 @@ from tpu_radix_join.performance.measurements import (BACKOFFMS, MEPOCH,
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness import verify as _verify
 from tpu_radix_join.robustness.membership import RankLost, StaleEpoch
+from tpu_radix_join.utils.hostsync import host_readback
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
                                              RETRIES_EXHAUSTED,
                                              RETRYABLE_SIZING, RetryPolicy,
@@ -418,7 +419,7 @@ class HashJoin:
         skew_plan = None
         if cfg.skew_threshold is not None and n > 1:
             hot = skew.detect_hot_partitions(
-                np.asarray(r_gh), np.asarray(s_gh), cfg.skew_threshold,
+                host_readback(r_gh), host_readback(s_gh), cfg.skew_threshold,
                 num_nodes=n)
             if hot.any():
                 hot_bits = skew.hot_mask_bits(hot)
@@ -696,7 +697,7 @@ class HashJoin:
             m.start("SNETCOMPL")
             dts["SNETCOMPL"] = m.stop("SNETCOMPL", fence=shuffled)
             dts["JMPI"] = m.stop("JMPI", fence=shuffled)
-        sflags = np.asarray(shuffled[2 if materialize else 5])
+        sflags = host_readback(shuffled[2 if materialize else 5])
         return shuffled, sflags, dts
 
     def _run_split(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
@@ -797,8 +798,8 @@ class HashJoin:
             if m:
                 dts["JPROC"] = m.stop("JPROC", fence=counts)
         flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
-                          int(np.asarray(local_flag)), sflags[4],
-                          int(np.asarray(count_risk))],
+                          int(host_readback(local_flag)), sflags[4],
+                          int(host_readback(count_risk))],
                          dtype=np.uint32)
         return counts, flags, dts
 
@@ -854,7 +855,7 @@ class HashJoin:
         if m:
             dts["JPROC"] = m.stop("JPROC", fence=valid)
         flags = np.array([sflags[0], sflags[1], sflags[2], sflags[3],
-                          int(np.asarray(ovf)), sflags[4]], dtype=np.uint32)
+                          int(host_readback(ovf)), sflags[4]], dtype=np.uint32)
         return r_rid, s_rid, valid, flags, dts
 
     def _bucket_caps(self, cap_r: int, cap_s: int, local_slack: int,
@@ -1368,7 +1369,7 @@ class HashJoin:
             return s, None
         if not getattr(s.key, "is_fully_addressable", True):
             return s, None   # multi-process shards: cannot mutate host-side
-        sk = np.asarray(s.key).copy()
+        sk = host_readback(s.key).copy()
         sk[0] ^= np.uint32(0x40000000)
         # keep an explicit mesh layout; a host-built array stays uncommitted
         # (shard_map lays it out), since device_put with its single-device
@@ -1395,9 +1396,9 @@ class HashJoin:
         allgathered first — the result-gather the reference does over MPI
         (main.cpp:120-135).  Single-process arrays convert directly."""
         if getattr(x, "is_fully_addressable", True):
-            return np.asarray(x)
+            return host_readback(x)
         from jax.experimental import multihost_utils
-        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+        return host_readback(multihost_utils.process_allgather(x, tiled=True))
 
     @staticmethod
     def _flags_to_diag(flags: np.ndarray) -> dict:
@@ -1808,7 +1809,7 @@ class HashJoin:
                     counts, flags = fn(r, s)
             if m:
                 m.stop("JPROC", fence=(counts, flags))
-            flags = np.asarray(flags)
+            flags = host_readback(flags)
             diag = self._flags_to_diag(flags)
             if verify_on and not flags.any():
                 result = self._verified_finish(
@@ -1843,7 +1844,7 @@ class HashJoin:
                     counts, flags = fn(r, s)
                 dts = ({"JPROC": m.stop("JPROC", fence=(counts, flags))}
                        if m else {})
-            flags = self._inject_shuffle_fault(np.asarray(flags))
+            flags = self._inject_shuffle_fault(host_readback(flags))
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
@@ -2027,10 +2028,10 @@ class HashJoin:
             sk, shi = _recovery.host_keys(self._elastic_rel[1])
         elif (getattr(r.key, "is_fully_addressable", True)
                 and getattr(s.key, "is_fully_addressable", True)):
-            rk = np.asarray(r.key)
-            sk = np.asarray(s.key)
-            rhi = None if r.key_hi is None else np.asarray(r.key_hi)
-            shi = None if s.key_hi is None else np.asarray(s.key_hi)
+            rk = host_readback(r.key)
+            sk = host_readback(s.key)
+            rhi = None if r.key_hi is None else host_readback(r.key_hi)
+            shi = None if s.key_hi is None else host_readback(s.key_hi)
         else:
             raise exc
         if m is not None and "JTOTAL" in m._starts:
@@ -2095,7 +2096,7 @@ class HashJoin:
         if result.diagnostics and result.diagnostics.get("recovered"):
             return
         num_p = self.config.network_partition_count
-        counts = np.asarray(result.partition_counts)
+        counts = host_readback(result.partition_counts)
         if counts.size < num_p or counts.size % num_p:
             return
         per_p = counts.astype(np.uint64).reshape(-1, num_p).sum(axis=0)
@@ -2169,8 +2170,8 @@ class HashJoin:
             m.event("fallback", path="chunked", ok=True, slab=slab)
             m.derive_rates()
         return JoinResult(matches=matches, ok=True,
-                          partition_counts=np.asarray([matches % (1 << 32)],
-                                                      np.uint32),
+                          partition_counts=np.array([matches % (1 << 32)],
+                                                    np.uint32),
                           diagnostics=diag)
 
     def _verified_finish(self, r: TupleBatch, s: TupleBatch,
@@ -2189,8 +2190,8 @@ class HashJoin:
         num_p = cfg.network_partition_count
         if m:
             m.start(VCHK)
-        pre_h = np.asarray(self._to_host(pre))
-        vchk_h = np.asarray(self._to_host(vchk))
+        pre_h = self._to_host(pre)
+        vchk_h = self._to_host(vchk)
         damaged = set()
         ncomp = 0
         for k in range(vchk_h.shape[0]):
@@ -2266,7 +2267,7 @@ class HashJoin:
                 TupleBatch(key=jnp.asarray(sk), rid=s.rid,
                            key_hi=None if shi is None else jnp.asarray(shi)),
                 slab, key_range="auto")
-            counts_out = np.asarray([matches % (1 << 32)], np.uint32)
+            counts_out = np.array([matches % (1 << 32)], np.uint32)
         else:
             cols = counts_h.reshape(cfg.num_nodes, num_p).astype(np.uint64)
             for p in dmg:
@@ -2391,7 +2392,7 @@ class HashJoin:
                 r_rid, s_rid, valid, flags = fn(r, s)
                 dts = ({"JPROC": m.stop("JPROC", fence=(r_rid, flags))}
                        if m else {})
-            flags = self._inject_shuffle_fault(np.asarray(flags))
+            flags = self._inject_shuffle_fault(host_readback(flags))
             diag = self._flags_to_diag(flags)
             if not flags.any() or not self._retryable(diag):
                 break
@@ -2406,9 +2407,9 @@ class HashJoin:
             if m and attempt < self.config.max_retries:
                 self._rollback_attempt(m, dts)
         if getattr(valid, "is_fully_addressable", True):
-            valid = np.asarray(valid)
-            r_rid = np.asarray(r_rid)[valid]
-            s_rid = np.asarray(s_rid)[valid]
+            valid = host_readback(valid)
+            r_rid = host_readback(r_rid)[valid]
+            s_rid = host_readback(s_rid)[valid]
         else:
             # multi-process: ONE collective for all three lanes instead of
             # three sequential full-buffer allgathers of mostly-padding rows

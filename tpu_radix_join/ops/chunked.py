@@ -61,6 +61,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.utils.hostsync import host_readback
 from tpu_radix_join.ops.merge_count import (
     MAX_MERGE_KEY,
     merge_count_chunks,
@@ -230,7 +231,7 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
         full = key_range == "full"
         if key_range == "auto":
             mx = (int(key_bound) if key_bound is not None else
-                  int(np.asarray(jnp.maximum(jnp.max(r.key),
+                  int(host_readback(jnp.maximum(jnp.max(r.key),
                                              jnp.max(s.key)))))
             if mx >= int(pad_sentinel("inner")):
                 raise _sentinel_corruption(mx)
@@ -255,12 +256,12 @@ def chunked_join_count(r: TupleBatch, s: TupleBatch, slab_size: int,
                 else:
                     mx_narrow = jnp.maximum(jnp.max(r.key), jnp.max(s.key))
     if mx_narrow is not None:
-        mx = int(np.asarray(mx_narrow))
+        mx = int(host_readback(mx_narrow))
         if mx > MAX_MERGE_KEY:
             raise _narrow_violation(mx)
     window = max(slab_size, -(-(r.key.shape[0] + slab_size) // 1024))
-    _check_weight_window(int(np.asarray(maxw)), window)
-    return int(np.asarray(per_slab).astype(np.uint64).sum())
+    _check_weight_window(int(host_readback(maxw)), window)
+    return int(host_readback(per_slab).astype(np.uint64).sum())
 
 
 class _Prefetcher:
@@ -309,7 +310,7 @@ class _Prefetcher:
                     bound = None
                     if getattr(chunk, "key_hi", None) is None:
                         # the bound readback doubles as the staging fence
-                        bound = int(np.asarray(jnp.max(chunk.key)))
+                        bound = int(host_readback(jnp.max(chunk.key)))
                     else:
                         jax.block_until_ready(chunk.key)
                 if self._meas is not None:
@@ -589,7 +590,7 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
     s_bounds: dict = {}
 
     def chunk_bound(batch) -> int:
-        return int(np.asarray(jnp.max(batch.key)))
+        return int(host_readback(jnp.max(batch.key)))
 
     last_i = start_i
 
@@ -703,8 +704,8 @@ def chunked_join_grid(r_chunks, s_chunks, slab_size: int,
             with span("readback_flush", drained=len(pending) - limit):
                 while len(pending) > limit:
                     pi, pj, per_slab, maxw, window = pending.popleft()
-                    _check_weight_window(int(np.asarray(maxw)), window)
-                    total += int(np.asarray(per_slab)
+                    _check_weight_window(int(host_readback(maxw)), window)
+                    total += int(host_readback(per_slab)
                                  .astype(np.uint64).sum())
                     done_this_run += 1
                     if writer is not None:

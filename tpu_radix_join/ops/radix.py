@@ -179,18 +179,27 @@ def reorder_by_partition(
         out = jax.tree.map(scatter, batch)
         hist = hist_x[:num_partitions]
         return out, scatter(pid), hist, exclusive_cumsum(hist)
-    order = jnp.argsort(sort_key, stable=False)
-    out = jax.tree.map(lambda x: x[order], batch)
+    # kv-sort through the ops/sorting switch instead of argsort + gather:
+    # the payload lanes travel with their key in one fused sort (a
+    # profiled 3x win over argsort+gather on v5e — see scatter_to_blocks),
+    # and the site inherits the xla-vs-pallas arm for free.  The key
+    # bound (ids are < num_partitions + 1, invalid rows routed to exactly
+    # num_partitions) lets the radix arm skip digit passes.
+    leaves, treedef = jax.tree.flatten(batch)
+    sorted_lanes = sort_kv_unstable(sort_key, *leaves, pid,
+                                    key_bound=num_partitions + 1)
+    key_s = sorted_lanes[0]
+    out = jax.tree.unflatten(treedef, sorted_lanes[1:-1])
     # run bounds over the already-sorted keys replace the separate
     # local_histogram pass: bounds[p] = #keys < p, so adjacent differences
     # are exactly the per-partition counts with invalid rows (key ==
     # num_partitions) excluded — byte-identical to the bincount, one fewer
     # pass over the ids
     bounds = jnp.searchsorted(
-        sort_key[order],
+        key_s,
         jnp.arange(num_partitions + 1, dtype=jnp.uint32)).astype(jnp.uint32)
     hist = bounds[1:] - bounds[:-1]
-    return out, pid[order], hist, exclusive_cumsum(hist)
+    return out, sorted_lanes[-1], hist, exclusive_cumsum(hist)
 
 
 def scatter_to_blocks(

@@ -56,6 +56,13 @@ _GATHER_BUF_BYTES = 1 << 16   # fixed allgather slot per process (gather_all)
 RTUPLES = "RTUPLES"        # inner tuples joined (counter)
 STUPLES = "STUPLES"        # outer tuples joined (counter)
 RESULTS = "RESULTS"        # global match count (RESULT_COUNTER analog)
+BPBUILD = "BPBUILD"        # bucket-path build phase timer (hash_join)
+BPPROBE = "BPPROBE"        # bucket-path probe phase timer
+BPBUILDTUPLES = "BPBUILDTUPLES"  # tuples hashed into build buckets
+BPPROBETUPLES = "BPPROBETUPLES"  # tuples probed against the buckets
+RETRIES = "RETRIES"        # engine capacity-regrow attempts superseded
+                           # (hash_join rollback; distinct from the
+                           # robustness layer's RETRYN policy attempts)
 MWINPUTCNT = "MWINPUTCNT"  # logical block transfers shuffled (MPI_Put count analog)
 MWINBYTES = "MWINBYTES"    # shuffle wire bytes incl. padding (8B/tuple slots)
 WIREBYTES = "WIREBYTES"    # actual wire bytes shipped per exchange under the

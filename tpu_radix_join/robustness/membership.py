@@ -43,6 +43,7 @@ from __future__ import annotations
 import json
 import os
 import socket
+import threading
 import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
@@ -125,6 +126,11 @@ class LeaseBoard:
         self.clock = clock
         self.measurements = measurements
         self._seq = 0
+        # heartbeat() runs on the metrics-sampler daemon tick (via
+        # sampler_extra) AND on the main thread's join loop — unguarded,
+        # both racers share one ``<path>.tmp.<pid>`` scratch name, so a
+        # torn interleaving can replace a half-written lease
+        self._lock = threading.Lock()
         self._t0 = clock()      # grace anchor for never-heartbeated ranks
         os.makedirs(run_dir, exist_ok=True)
 
@@ -135,27 +141,29 @@ class LeaseBoard:
     def heartbeat(self, epoch: int = 0) -> dict:
         """Write this rank's lease; returns the lease dict (merged into
         sampler ticks by :meth:`sampler_extra`).  Never raises."""
-        self._seq += 1
-        rec = {"rank": self.rank, "epoch": int(epoch),
-               "t_epoch_s": self.clock(), "pid": os.getpid(),
-               "host": socket.gethostname(), "seq": self._seq}
-        path = self.lease_path(self.rank)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(rec, f)
-                f.flush()
-            os.replace(tmp, path)
-        except OSError as e:
-            rec = dict(rec, error=repr(e))
-            m = self.measurements
-            if m is not None:
-                m.event("lease_write_failed", rank=self.rank, error=repr(e))
+        with self._lock:
+            self._seq += 1
+            rec = {"rank": self.rank, "epoch": int(epoch),
+                   "t_epoch_s": self.clock(), "pid": os.getpid(),
+                   "host": socket.gethostname(), "seq": self._seq}
+            path = self.lease_path(self.rank)
+            tmp = f"{path}.tmp.{os.getpid()}"
             try:
-                os.remove(tmp)
-            except OSError:
-                pass
-        return rec
+                with open(tmp, "w") as f:
+                    json.dump(rec, f)
+                    f.flush()
+                os.replace(tmp, path)
+            except OSError as e:
+                rec = dict(rec, error=repr(e))
+                m = self.measurements
+                if m is not None:
+                    m.event("lease_write_failed", rank=self.rank,
+                            error=repr(e))
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            return rec
 
     def sampler_extra(self, epoch_of: Optional[Callable[[], int]] = None
                       ) -> Callable[[], dict]:

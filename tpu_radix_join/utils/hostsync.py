@@ -1,0 +1,31 @@
+"""Explicit device→host readback: the one sanctioned sync point.
+
+The engine's hot paths must not contain *implicit* host syncs —
+``np.asarray(device_array)``, ``int(jnp_scalar)``, ``.item()`` — because
+each one blocks the dispatch thread mid-pipeline and, worse, hides from
+review: an accidental readback reads exactly like a deliberate one.  Two
+witnesses now police this:
+
+  * statically, the ``sync-point`` lint rule (analysis/rules_sync.py)
+    flags the implicit spellings in the engine/grid hot files;
+  * at runtime, ``jax.transfer_guard("disallow")`` (armed by
+    ``main.py --transfer-guard`` or the tests' ``transfer_guard``
+    fixture) raises on any implicit transfer.
+
+:func:`host_readback` is the escape hatch both accept: it routes through
+``jax.device_get`` — an *explicit* transfer, allowed under the guard —
+so every surviving sync point is a visible, greppable decision.  The
+semantics match ``np.asarray(x)`` for every input the call sites use
+(device arrays, numpy arrays, scalars, and lists of either: device_get
+maps over pytree leaves and the asarray re-assembles the result).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+
+def host_readback(x) -> np.ndarray:
+    """Blocking device→host copy as a numpy array (explicit transfer)."""
+    return np.asarray(jax.device_get(x))
