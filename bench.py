@@ -966,6 +966,21 @@ def main():
             print(f"error: baseline {check_baseline} not found",
                   file=sys.stderr)
             sys.exit(2)
+    if "--static-gate" in argv:
+        # merged static-analysis gate (tools_static_gate.py): graftlint
+        # AST conventions + graftcheck jaxpr IR audit, both strict,
+        # device-free — gates program invariants, not throughput.  Rides
+        # bench so CI rigs that only know bench entry points can run it.
+        import tools_static_gate
+        gate_args = []
+        if "--static-gate-json" in argv:
+            i = argv.index("--static-gate-json")
+            if i + 1 >= len(argv):
+                print("error: --static-gate-json needs a file path",
+                      file=sys.stderr)
+                sys.exit(2)
+            gate_args = ["--json", argv[i + 1]]
+        sys.exit(tools_static_gate.main(gate_args))
     if "--grid-bench" in argv:
         # like --chaos: CPU-sized, exits before the chip-reservation
         # machinery — it gates the pipelined grid engine, not the chip

@@ -246,6 +246,82 @@ def test_waiver_needs_a_reason(tmp_path):
     assert len(found) == 1, "a reasonless waiver must suppress nothing"
 
 
+# -------------------------------------------------------------- lock-order
+
+_CROSS_CLASS_CYCLE = """
+    import threading
+
+    class MetricsSampler:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._board = LeaseBoard()
+
+        def tick(self):
+            with self._lock:
+                self._board.heartbeat()   # acquires LeaseBoard._lock
+
+    class LeaseBoard:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._sampler = MetricsSampler()
+
+        def heartbeat(self):
+            with self._lock:
+                pass
+
+        def report(self):
+            with self._lock:
+                self._sampler.tick()      # acquires MetricsSampler._lock
+"""
+
+
+def test_lock_order_fires_on_cross_class_cycle(tmp_path):
+    """The known-bad fixture: sampler-tick holds its lock while taking
+    the board's; board-report holds its lock while taking the
+    sampler's.  Two threads interleaving deadlock — one finding, the
+    cycle spelled out."""
+    found, _ = _lint(tmp_path, "bad_order.py", _CROSS_CLASS_CYCLE,
+                     ["lock-order"])
+    assert len(found) == 1
+    assert "MetricsSampler._lock" in found[0].message
+    assert "LeaseBoard._lock" in found[0].message
+    assert found[0].key.startswith("cycle:")
+
+
+def test_lock_order_fires_on_nested_with_inversion(tmp_path):
+    found, _ = _lint(tmp_path, "bad_nested.py", """
+        class AdmissionQueue:
+            def submit(self):
+                with self._lock:
+                    with self._brk_lock:
+                        pass
+
+            def drain(self):
+                with self._brk_lock:
+                    with self._lock:
+                        pass
+        """, ["lock-order"])
+    assert len(found) == 1          # one canonical cycle, not one per entry
+    assert "deadlock" in found[0].message
+
+
+def test_lock_order_passes_consistent_global_order(tmp_path):
+    # everyone takes _lock before _brk_lock: edges, but no cycle
+    found, _ = _lint(tmp_path, "good_order.py", """
+        class AdmissionQueue:
+            def submit(self):
+                with self._lock:
+                    with self._brk_lock:
+                        pass
+
+            def drain(self):
+                with self._lock:
+                    with self._brk_lock:
+                        pass
+        """, ["lock-order"])
+    assert found == []
+
+
 def test_waiver_token_is_rule_specific(tmp_path):
     # a sync waiver does not silence the sort rule
     found, _ = _lint(tmp_path, "tpu_radix_join/foo.py",

@@ -1,0 +1,90 @@
+"""tools_static_gate.py: the merged AST + IR gate as a tier-1 test,
+plus the regress-gate direction pins for the new static counters."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+
+def test_repo_passes_the_merged_static_gate(tmp_path, capsys):
+    """The gating check itself: graftlint --strict + graftcheck --strict
+    over the committed tree.  A live finding, a stale baseline entry, or
+    a trace failure in either layer fails this test — which is the
+    point."""
+    import tools_static_gate
+    out_json = tmp_path / "gate.json"
+    rc = tools_static_gate.main(["--json", str(out_json)])
+    printed = capsys.readouterr().out
+    assert rc == 0, printed
+    summary = json.loads(out_json.read_text())
+    assert summary["gate_exit"] == 0
+    assert summary["layers"] == {"lint": 0, "jaxpr": 0}
+    assert summary["lint_findings"] == 0
+    assert summary["jaxpr_findings"] == 0
+    assert summary["stale_baseline"] == 0
+    # the IR layer really traced the engine (stats carry live-set peaks)
+    assert summary["jaxpr_stats"]["pipeline"]["peak_live_bytes"] > 0
+    assert "== graftlint (AST) ==" in printed
+    assert "== graftcheck (jaxpr IR) ==" in printed
+
+
+def test_gate_merges_worst_exit(monkeypatch, tmp_path):
+    import tools_jaxpr_audit
+    import tools_lint
+    import tools_static_gate
+    monkeypatch.setattr(tools_lint, "main", lambda argv: 0)
+    monkeypatch.setattr(tools_jaxpr_audit, "main", lambda argv: 1)
+    assert tools_static_gate.main([]) == 1
+    monkeypatch.setattr(tools_jaxpr_audit, "main", lambda argv: 2)
+    assert tools_static_gate.main([]) == 2
+    monkeypatch.setattr(tools_jaxpr_audit, "main", lambda argv: 0)
+    assert tools_static_gate.main([]) == 0
+    # --skip-jaxpr consults only the AST layer
+    monkeypatch.setattr(tools_jaxpr_audit, "main",
+                        lambda argv: pytest.fail("traced despite skip"))
+    assert tools_static_gate.main(["--skip-jaxpr"]) == 0
+
+
+def test_audit_cli_contract(tmp_path, capsys):
+    """tools_jaxpr_audit.py: exit 0 clean + JSON counts + exit 2 on a
+    bad baseline (the mandatory-reason contract)."""
+    import tools_jaxpr_audit
+    out_json = tmp_path / "audit.json"
+    rc = tools_jaxpr_audit.main(["--entry", "pipeline",
+                                 "--json", str(out_json)])
+    assert rc == 0
+    summary = json.loads(out_json.read_text())
+    assert summary["jaxpr_findings"] == 0
+    assert summary["entries"] == ["pipeline"]
+    capsys.readouterr()
+    bad = tmp_path / "bad_baseline.json"
+    bad.write_text(json.dumps({"suppressions": [
+        {"rule": "donation", "path": "p", "key": "k", "reason": ""}]}))
+    rc = tools_jaxpr_audit.main(["--entry", "pipeline",
+                                 "--baseline", str(bad)])
+    assert rc == 2
+    assert "reason" in capsys.readouterr().err
+    # a deliberately tiny budget turns the clean trace into findings
+    rc = tools_jaxpr_audit.main(["--entry", "pipeline", "--no-baseline",
+                                 "--memory-budget", "4096"])
+    assert rc == 1
+    assert "static-memory" in capsys.readouterr().out
+
+
+def test_regress_pins_static_counters():
+    from tpu_radix_join.observability.regress import (NEUTRAL_TAGS,
+                                                      higher_is_better,
+                                                      tag_is_declared)
+    # JSON gauge names: more findings / stale entries is strictly worse
+    assert not higher_is_better("jaxpr_findings")
+    assert not higher_is_better("stale_baseline")
+    assert not higher_is_better("lint_findings")
+    # counter tags: JXAUDIT gates lower-better, STATICMEM is geometry
+    assert not higher_is_better("JXAUDIT")
+    assert "STATICMEM" in NEUTRAL_TAGS
+    assert tag_is_declared("JXAUDIT") and tag_is_declared("STATICMEM")

@@ -1,0 +1,93 @@
+"""The chunked/grid and service paths under the runtime transfer guard.
+
+tests/test_hash_join.py already proves the 8-way engine path is
+guard-clean; these tests extend the same discipline to the other two
+dispatch surfaces — the out-of-core chunked/grid engine (slab loop,
+both-sides grid, and the pipelined prefetcher) and the service session
+(submit/run_next with the sizing pre-pass and warm-cache reuse).  All
+inputs are pre-placed with an explicit ``jax.device_put`` before the
+fixture arms ``jax.transfer_guard("disallow")``; a failure here means a
+code path regained an implicit host transfer the static ``transfer``
+IR rule (analysis/jaxpr/rules_ir.py) and ``sync-point`` AST rule exist
+to prevent.  These paths are clean today, so LINT_BASELINE.json carries
+no transfer-guard survivors for them — keep it that way.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_radix_join.data.relation import host_join_count
+from tpu_radix_join.data.tuples import TupleBatch
+from tpu_radix_join.ops.chunked import chunked_join_count, chunked_join_grid
+
+NODES = 8
+
+
+def _placed_batch(keys):
+    """TupleBatch pre-placed on device — explicit, so legal under the
+    guard; anything the join then moves implicitly is a finding."""
+    keys = np.asarray(keys, np.uint32)
+    return TupleBatch(
+        key=jax.device_put(jnp.asarray(keys)),
+        rid=jax.device_put(jnp.arange(len(keys), dtype=jnp.uint32)))
+
+
+@pytest.fixture
+def guarded_inputs():
+    rng = np.random.default_rng(14)
+    r = rng.integers(0, 1024, 1 << 12).astype(np.uint32)
+    s = rng.integers(0, 1024, 1 << 12).astype(np.uint32)
+    return r, s, host_join_count(r, s)
+
+
+def test_chunked_slab_loop_under_guard(guarded_inputs, transfer_guard):
+    r, s, expect = guarded_inputs
+    rb, sb = _placed_batch(r), _placed_batch(s)
+    assert chunked_join_count(rb, sb, 1 << 10) == expect
+
+
+def test_chunked_grid_under_guard(guarded_inputs, transfer_guard):
+    r, s, expect = guarded_inputs
+    r_chunks = [_placed_batch(r[:1 << 11]), _placed_batch(r[1 << 11:])]
+    s_chunks = [_placed_batch(s[:1 << 11]), _placed_batch(s[1 << 11:])]
+    assert chunked_join_grid(r_chunks, s_chunks, 1 << 10) == expect
+
+
+def test_chunked_grid_pipelined_under_guard(guarded_inputs, transfer_guard):
+    # the prefetcher thread stages the next pair while the current one
+    # joins — its hand-off must also move no implicit bytes
+    r, s, expect = guarded_inputs
+    r_chunks = [_placed_batch(r[:1 << 11]), _placed_batch(r[1 << 11:])]
+    s_chunks = [_placed_batch(s[:1 << 11]), _placed_batch(s[1 << 11:])]
+    assert chunked_join_grid(r_chunks, s_chunks, 1 << 10,
+                             pipeline="on") == expect
+
+
+@pytest.mark.slow
+def test_service_session_under_guard():
+    """submit/run_next — cold (sizing pre-pass) then warm (capacity
+    cache hit) — with the guard armed around the engine dispatches.
+    The session generates its inputs on device from the request seed,
+    so the whole query lifecycle stays implicit-transfer-free."""
+    from tpu_radix_join import JoinConfig
+    from tpu_radix_join.core.config import ServiceConfig
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.service import JoinSession, QueryRequest
+
+    m = Measurements()
+    sess = JoinSession(JoinConfig(num_nodes=NODES), ServiceConfig(),
+                       measurements=m)
+    try:
+        sess.submit(QueryRequest(query_id="g0", tenant="t",
+                                 tuples_per_node=1024, seed=7))
+        sess.submit(QueryRequest(query_id="g1", tenant="t",
+                                 tuples_per_node=1024, seed=7))
+        with jax.transfer_guard("disallow"):
+            cold = sess.run_next()
+            warm = sess.run_next()
+        assert cold.status == "ok" and warm.status == "ok"
+        assert warm.matches == cold.matches
+    finally:
+        sess.close()

@@ -1,0 +1,146 @@
+"""graftcheck CLI: the jaxpr-level IR audit as a gating check.
+
+    python tools_jaxpr_audit.py                  # all rules, all entries
+    python tools_jaxpr_audit.py --strict         # stale suppressions fail
+    python tools_jaxpr_audit.py --rule transfer --rule donation
+    python tools_jaxpr_audit.py --entry pipeline --entry shuffle
+    python tools_jaxpr_audit.py --memory-budget 268435456
+    python tools_jaxpr_audit.py --list-rules
+    python tools_jaxpr_audit.py --json JXAUDIT.json
+
+Traces every jitted engine entry point abstractly (``jax.make_jaxpr``
+over ShapeDtypeStruct inputs — no arrays, no compile, no device
+dispatch; 8 virtual CPU devices are forced before jax imports, so this
+runs device-free under ``JAX_PLATFORMS=cpu`` in tier-1 CI) and walks
+the lowered programs with the IR rules in
+``tpu_radix_join/analysis/jaxpr/``:
+
+    transfer         implicit device_put / host callback in a hot jit
+    collective-axis  collectives name live mesh axes, sizes consistent
+    width            uint32 lanes silently widening to i64/f64/f32
+    donation         dead-after-use inputs without donate_argnums
+    static-memory    live-set peak vs --memory-budget (informational
+                     when the budget is unarmed: peak still reported)
+
+Exit contract matches tools_lint.py (0 clean / 1 findings or, under
+--strict, stale suppressions / 2 usage-IO-trace errors); the committed
+suppression file is ``JXAUDIT_BASELINE.json`` at the repo root, every
+entry with a mandatory reason.  ``--json`` writes
+``{"jaxpr_findings": N, ...}``; ``jaxpr_findings`` is pinned
+lower-is-better in observability/regress.py.  ``tools_static_gate.py``
+chains this with graftlint for the single merged CI gate.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools_jaxpr_audit.py",
+        description="Trace the engine's jitted entry points abstractly "
+                    "and run the jaxpr-level IR rules.")
+    p.add_argument("--rule", action="append", default=[], metavar="ID",
+                   help="run only this IR rule id, repeatable "
+                        "(default: all)")
+    p.add_argument("--entry", action="append", default=[], metavar="NAME",
+                   help="trace only this entry point, repeatable "
+                        "(default: all)")
+    p.add_argument("--nodes", type=int, default=8,
+                   help="mesh width to trace at (default: 8)")
+    p.add_argument("--per-node", type=int, default=8192,
+                   help="tuples per node for the traced shapes")
+    p.add_argument("--cap", type=int, default=2048,
+                   help="wire slots per (sender, destination) block")
+    p.add_argument("--memory-budget", type=int, default=None,
+                   metavar="BYTES",
+                   help="arm the static-memory rule: finding when any "
+                        "entry's live-set peak exceeds this")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help="suppression file (default: JXAUDIT_BASELINE.json "
+                        "at the repo root)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--strict", action="store_true",
+                   help="stale baseline suppressions also fail (exit 1)")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print rule ids + docs and exit 0")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="also write machine-readable counts "
+                        "({'jaxpr_findings': N, ...})")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    # devices before jax: abstract tracing still builds the engine mesh
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(max(args.nodes, 8), respect_existing=True)
+    from tpu_radix_join.analysis.core import LintError
+    from tpu_radix_join.analysis.jaxpr import (AuditContext, IR_RULES,
+                                               JXAUDIT_BASELINE,
+                                               register_ir_rules, run_audit)
+    register_ir_rules()
+    if args.list_rules:
+        for rid in sorted(IR_RULES):
+            r = IR_RULES[rid]
+            print(f"{rid:18s} [{r.token}] {r.doc}")
+        return 0
+    baseline = None
+    if not args.no_baseline:
+        baseline = args.baseline or os.path.join(REPO_ROOT,
+                                                 JXAUDIT_BASELINE)
+        if args.baseline and not os.path.exists(args.baseline):
+            print(f"error: baseline {args.baseline} not found",
+                  file=sys.stderr)
+            return 2
+    ctx = AuditContext(memory_budget_bytes=args.memory_budget)
+    try:
+        from tpu_radix_join.analysis.jaxpr.trace import build_entries
+        views = build_entries(num_nodes=args.nodes, per_node=args.per_node,
+                              cap=args.cap, entries=args.entry or None)
+        res = run_audit(views, rule_ids=args.rule or None,
+                        baseline_path=baseline, ctx=ctx)
+    except LintError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    for f in res.findings:
+        print(f.render())
+    for e in res.stale:
+        print(f"stale suppression: {e['rule']} {e['path']} key={e['key']!r}"
+              f" — finding no longer fires; remove the entry")
+    per_rule = {}
+    for f in res.findings:
+        per_rule[f.rule] = per_rule.get(f.rule, 0) + 1
+    summary = {"jaxpr_findings": len(res.findings),
+               "suppressed": len(res.suppressed),
+               "stale_baseline": len(res.stale),
+               "rules_run": res.rules,
+               "entries": res.entries,
+               "per_rule": per_rule,
+               "stats": res.stats}
+    if args.json:
+        try:
+            with open(args.json, "w") as fh:
+                json.dump(summary, fh, indent=2)
+        except OSError as e:
+            print(f"error: cannot write {args.json}: {e}", file=sys.stderr)
+            return 2
+    code = res.exit_code(strict=args.strict)
+    verdict = "clean" if code == 0 else "FINDINGS"
+    print(f"jaxpr audit: {verdict} — {len(res.findings)} finding(s), "
+          f"{len(res.suppressed)} baselined, {len(res.stale)} stale "
+          f"suppression(s), {len(res.entries)} entr"
+          f"{'y' if len(res.entries) == 1 else 'ies'}, "
+          f"rules: {', '.join(res.rules)}")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,92 @@
+"""The single static-analysis gate: graftlint + graftcheck, one exit.
+
+    python tools_static_gate.py                  # both layers, strict
+    python tools_static_gate.py --json GATE.json
+
+Chains the two static layers in-process:
+
+    1. graftlint  (tools_lint.py --strict)        — AST conventions
+    2. graftcheck (tools_jaxpr_audit.py --strict) — lowered-program IR
+
+Both run strict, so a live finding *or* a stale baseline suppression in
+either layer fails the gate — baseline files only ever shrink.  The
+merged exit keeps the shared contract: 0 only when both layers are
+clean, 1 when either has findings/stale entries, 2 when either hit a
+usage/IO/trace error (an unreadable baseline must not read as "clean").
+Wired as a tier-1 test (tests/test_static_gate.py) and into ``bench.py
+--static-gate``; the JSON counts (``lint_findings``,
+``jaxpr_findings``, ``stale_baseline``) are pinned lower-is-better in
+observability/regress.py so CI can gate their growth like a perf
+regression.
+"""
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tools_static_gate.py",
+        description="Run graftlint + graftcheck strict as one gate.")
+    p.add_argument("--json", default=None, metavar="FILE",
+                   help="write merged machine-readable counts")
+    p.add_argument("--skip-jaxpr", action="store_true",
+                   help="AST layer only (no tracing; sub-second)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    import tools_jaxpr_audit
+    import tools_lint
+
+    summary = {}
+    codes = {}
+    with tempfile.TemporaryDirectory() as td:
+        lint_json = os.path.join(td, "lint.json")
+        print("== graftlint (AST) ==")
+        codes["lint"] = tools_lint.main(["--strict", "--json", lint_json])
+        if os.path.exists(lint_json):
+            with open(lint_json) as fh:
+                summary.update(json.load(fh))
+        if not args.skip_jaxpr:
+            audit_json = os.path.join(td, "audit.json")
+            print("== graftcheck (jaxpr IR) ==")
+            codes["jaxpr"] = tools_jaxpr_audit.main(
+                ["--strict", "--json", audit_json])
+            if os.path.exists(audit_json):
+                with open(audit_json) as fh:
+                    audit = json.load(fh)
+                # merge without clobbering the lint layer's counts
+                summary["jaxpr_findings"] = audit.get("jaxpr_findings")
+                summary["jaxpr_suppressed"] = audit.get("suppressed")
+                summary["stale_baseline"] = (
+                    (summary.get("stale_baseline") or 0)
+                    + (audit.get("stale_baseline") or 0))
+                summary["jaxpr_entries"] = audit.get("entries")
+                summary["jaxpr_stats"] = audit.get("stats")
+    code = (2 if 2 in codes.values()
+            else 1 if 1 in codes.values() else 0)
+    summary["gate_exit"] = code
+    summary["layers"] = codes
+    if args.json:
+        try:
+            with open(args.json, "w") as fh:
+                json.dump(summary, fh, indent=2)
+        except OSError as e:
+            print(f"error: cannot write {args.json}: {e}", file=sys.stderr)
+            return 2
+    print(f"static gate: {'clean' if code == 0 else 'FAIL'} "
+          f"(layers: {codes})")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

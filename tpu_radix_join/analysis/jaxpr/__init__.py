@@ -1,0 +1,45 @@
+"""graftcheck: jaxpr-level static analysis for tpu_radix_join.
+
+graftlint (the parent package) checks the *source text*; graftcheck
+checks the *lowered program*.  The framework lives in :mod:`core`
+(AvalView/EqnView/ProgramView, IR rule registry, baseline, runner), the
+tracer/entry registry in :mod:`trace`, and the rules in:
+
+  =================  ===================================================
+  transfer           no implicit device_put / host callback in hot jits
+  collective-axis    collectives name live mesh axes, sizes consistent,
+                     all_to_all splits divide evenly
+  width              uint32 lanes must not widen to i64/f64/f32
+  donation           dead-after-use inputs carry donate_argnums
+  static-memory      live-set peak fits the armed memory budget
+  =================  ===================================================
+
+Plan cross-validation (:mod:`crossval`) diffs jaxpr-derived exchange
+bytes against the cost model — the ``STATIC-DRIFT`` column in ``--plan
+explain``.  CLI: ``tools_jaxpr_audit.py`` at the repo root; tier-1
+gate: ``tests/test_static_gate.py``.
+"""
+
+from tpu_radix_join.analysis.jaxpr.core import (AuditContext, AuditResult,
+                                                AvalView, EqnView,
+                                                IR_RULES, IRRule,
+                                                JXAUDIT_BASELINE,
+                                                ProgramView, ir_rule,
+                                                load_ir_baseline, run_audit)
+
+_REGISTERED = False
+
+
+def register_ir_rules() -> None:
+    """Import the rule modules (idempotent): importing registers."""
+    global _REGISTERED
+    if _REGISTERED:
+        return
+    from tpu_radix_join.analysis.jaxpr import (memory,      # noqa: F401
+                                               rules_ir)    # noqa: F401
+    _REGISTERED = True
+
+
+__all__ = ["AuditContext", "AuditResult", "AvalView", "EqnView", "IR_RULES",
+           "IRRule", "JXAUDIT_BASELINE", "ProgramView", "ir_rule",
+           "load_ir_baseline", "run_audit", "register_ir_rules"]

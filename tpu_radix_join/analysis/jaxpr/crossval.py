@@ -1,0 +1,85 @@
+"""Plan cross-validation: jaxpr-derived traffic vs cost-model prices.
+
+The planner prices the exchange analytically (``plan_exchange`` →
+``ExchangePlan.bytes_per_tuple`` and the shuffle wire model); the
+traced program states what it *actually* moves — the summed operand
+bytes of its ``all_to_all`` equations.  This module diffs the two:
+
+* :func:`static_exchange_bytes` — per-node shipped bytes read off the
+  jaxpr.  The engine's ``_exchange_stats`` defines WIREBYTES as "bytes
+  each node ships", which on the traced program is exactly the sum of
+  per-device all_to_all operand bytes (shard_map body avals are
+  per-device) — no mesh multiplication, so the 10%% A/B in
+  tests/test_jaxpr_audit.py compares like with like.
+* :func:`static_for_explain` — the ``STATIC-DRIFT`` column: traced
+  bytes-per-slot vs the plan's ``bytes_per_tuple``.  The per-slot basis
+  makes the comparison capacity-free — pow-of-two wire-cap slack
+  inflates both arms identically and cancels, so persistent drift means
+  the *codec/geometry model* is wrong (a second, execution-free
+  grounding signal next to PR 9's runtime staleness), not that the
+  workload was padded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+#: collectives counted for the per-phase account
+_COUNTED = ("all_to_all", "psum", "pmin", "pmax", "ppermute", "all_gather",
+            "reduce_scatter")
+
+
+def static_exchange_bytes(view) -> int:
+    """Per-node shipped bytes: summed all_to_all operand bytes (the
+    traced program's own WIREBYTES)."""
+    return sum(e.in_bytes() for e in view.eqns if e.prim == "all_to_all")
+
+
+def collective_counts(view) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for e in view.eqns:
+        if e.prim in _COUNTED:
+            counts[e.prim] = counts.get(e.prim, 0) + 1
+    return counts
+
+
+def static_slots(view) -> int:
+    """Wire slots per node shipped by the all_to_all equations, in
+    uint32 lanes: operand elements / 1 lane each (key+rid = 2 lanes =
+    8 bytes/tuple raw)."""
+    slots = 0
+    for e in view.eqns:
+        if e.prim == "all_to_all":
+            for v in e.invals:
+                n = 1
+                for d in v.shape:
+                    n *= d
+                slots += n
+    return slots
+
+
+def static_for_explain(view, xplan) -> Optional[dict]:
+    """STATIC-DRIFT payload for ``explain_table``.
+
+    ``view`` is the traced shuffle/pipeline entry; ``xplan`` the chosen
+    strategy's ``ExchangePlan``.  Returns None when either side has no
+    wire traffic to compare (e.g. single-node)."""
+    bytes_moved = static_exchange_bytes(view)
+    lanes = static_slots(view)
+    bpt = float(getattr(xplan, "bytes_per_tuple", 0.0) or 0.0)
+    if bytes_moved <= 0 or lanes <= 0 or bpt <= 0.0:
+        return None
+    # 2 uint32 lanes per tuple slot (key + rid); codec stages repack the
+    # same tuple basis, so static bytes/tuple-slot is comparable to the
+    # plan's bytes_per_tuple on every codec arm.
+    tuple_slots = lanes / 2.0
+    static_bpt = bytes_moved / tuple_slots
+    drift_pct = 100.0 * (static_bpt - bpt) / bpt
+    return {
+        "entry": view.name,
+        "static_bytes": int(bytes_moved),
+        "static_bytes_per_tuple": static_bpt,
+        "plan_bytes_per_tuple": bpt,
+        "drift_pct": drift_pct,
+        "collectives": collective_counts(view),
+    }

@@ -1,0 +1,204 @@
+"""The graftcheck IR rules: transfer, collective-axis, width, donation.
+
+Each rule reads one invariant off the lowered program that the AST
+rules cannot see (the static-memory rule lives in ``memory.py`` next
+to its live-set walk):
+
+* ``transfer`` — the static twin of ``jax.transfer_guard``: a
+  ``device_put`` of non-trivial bytes staged *inside* a traced hot
+  program is an implicit placement the runtime guard would reject on
+  a real mesh, and a host callback equation is a synchronous host
+  round-trip no matter what the guard says.  Scalar re-placements
+  (ALIAS-semantics device_puts of () shapes, e.g. weak-typed ints
+  crossing a cond) are below ``transfer_min_bytes`` and stay silent.
+* ``collective-axis`` — every collective must name an axis of the
+  mesh its shard_map binds, with a consistent size: an all_to_all
+  whose split dimension the axis size does not divide is exactly the
+  staged-exchange column-group bug (window.block_all_to_all pads to
+  make this true; a program where it is false silently drops tuples
+  on a real backend).
+* ``width`` — a uint32 lane that widens to i64/f64 doubles the wire
+  and HBM bytes of every downstream equation; widening to f32 loses
+  key bits above 2**24.  Either way it is silent in the source (jnp
+  promotion) and loud here.
+* ``donation`` — a program input that is big, consumed, not returned,
+  and not donated holds two generations of the buffer live across the
+  call boundary.  The finding names the concrete ``donate_argnums``
+  fix; the engine's split-path programs apply it via
+  ``operators.hash_join.split_donation`` and the deliberately
+  undonated entries carry registry waivers with reasons.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tpu_radix_join.analysis.core import Finding
+from tpu_radix_join.analysis.jaxpr.core import (AuditContext, EqnView,
+                                                ProgramView, ir_rule)
+
+#: host-callback primitives: always a synchronous host round-trip
+CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback"}
+
+#: collective primitives and the param key carrying their axis name(s)
+COLLECTIVE_AXIS_PARAMS = {
+    "psum": "axes", "pmin": "axes", "pmax": "axes",
+    "all_to_all": "axis_name", "ppermute": "axis_name",
+    "all_gather": "axis_name", "reduce_scatter": "axis_name",
+    "axis_index": "axis_name",
+}
+
+#: 4-byte lane dtypes the wire path ships
+_LANE_DTYPES = ("uint32", "int32")
+#: widened dtypes that double bytes (i64/u64/f64) or drop key bits (f32)
+_WIDE_DTYPES = ("int64", "uint64", "float64", "float32")
+
+
+def _eqn_finding(view: ProgramView, eqn: EqnView, rule_id: str, key: str,
+                 message: str) -> Finding:
+    path, line = eqn.source_path_line()
+    if not path:
+        path, line = f"jaxpr:{view.name}", 0
+    return Finding(rule=rule_id, path=path, line=line, key=key,
+                   message=message)
+
+
+@ir_rule("transfer",
+         "no implicit device_put / host callback inside a hot program",
+         "jx-transfer")
+def rule_transfer(view: ProgramView, ctx: AuditContext) -> List[Finding]:
+    out: List[Finding] = []
+    for eqn in view.eqns:
+        if eqn.prim in CALLBACK_PRIMS:
+            out.append(_eqn_finding(
+                view, eqn, "transfer", f"{view.name}:{eqn.prim}",
+                f"[{view.name}] host callback '{eqn.prim}' staged inside "
+                f"a jitted hot program — a synchronous device->host round "
+                f"trip per dispatch; hoist it out of the traced path or "
+                f"route the readback through utils.hostsync.host_readback "
+                f"after the fence"))
+        elif (eqn.prim == "device_put"
+              and eqn.in_bytes() >= ctx.transfer_min_bytes):
+            out.append(_eqn_finding(
+                view, eqn, "transfer",
+                f"{view.name}:device_put:{eqn.in_bytes()}",
+                f"[{view.name}] device_put of {eqn.in_bytes()} bytes "
+                f"traced into the program ({eqn.source or 'no frame'}) — "
+                f"an implicit placement the transfer guard would reject; "
+                f"pre-place the operand with an explicit jax.device_put "
+                f"outside the jit"))
+    return out
+
+
+@ir_rule("collective-axis",
+         "collectives name live mesh axes with consistent sizes; "
+         "all_to_all splits divide evenly",
+         "jx-axis")
+def rule_collective_axis(view: ProgramView, ctx: AuditContext
+                         ) -> List[Finding]:
+    out: List[Finding] = []
+    for eqn in view.eqns:
+        param_key = COLLECTIVE_AXIS_PARAMS.get(eqn.prim)
+        if param_key is None or not eqn.mesh_axes:
+            continue
+        names = eqn.params.get(param_key)
+        if names is None:
+            continue
+        if not isinstance(names, (tuple, list)):
+            names = (names,)
+        for name in names:
+            if not isinstance(name, str):
+                continue    # positional (unnamed) axes: nothing to check
+            if name not in eqn.mesh_axes:
+                out.append(_eqn_finding(
+                    view, eqn, "collective-axis",
+                    f"{view.name}:{eqn.prim}:{name}",
+                    f"[{view.name}] {eqn.prim} names axis {name!r} but "
+                    f"the enclosing shard_map binds "
+                    f"{sorted(eqn.mesh_axes)} — the collective would "
+                    f"reduce over a dead axis"))
+                continue
+            size = eqn.mesh_axes[name]
+            decl = eqn.params.get("axis_size")
+            if decl is not None and int(decl) != size:
+                out.append(_eqn_finding(
+                    view, eqn, "collective-axis",
+                    f"{view.name}:{eqn.prim}:{name}:size",
+                    f"[{view.name}] {eqn.prim} declares axis_size "
+                    f"{int(decl)} but mesh axis {name!r} has size "
+                    f"{size}"))
+            if eqn.prim == "all_to_all" and eqn.invals:
+                split = eqn.params.get("split_axis")
+                shape = eqn.invals[0].shape
+                if (split is not None and int(split) < len(shape)
+                        and shape[int(split)] % size != 0):
+                    out.append(_eqn_finding(
+                        view, eqn, "collective-axis",
+                        f"{view.name}:all_to_all:{name}:divisibility",
+                        f"[{view.name}] all_to_all split dim "
+                        f"{int(split)} has extent {shape[int(split)]}, "
+                        f"not divisible by axis {name!r} size {size} — "
+                        f"the staged-exchange column groups would "
+                        f"misalign (window.block_all_to_all pads "
+                        f"exactly to prevent this)"))
+    return out
+
+
+@ir_rule("width",
+         "uint32 lanes must not silently widen to i64/f64/f32",
+         "jx-width")
+def rule_width(view: ProgramView, ctx: AuditContext) -> List[Finding]:
+    out: List[Finding] = []
+    for eqn in view.eqns:
+        if eqn.prim != "convert_element_type" or not eqn.invals:
+            continue
+        src = eqn.invals[0]
+        dst = str(eqn.params.get("new_dtype", ""))
+        if (src.dtype in _LANE_DTYPES and dst in _WIDE_DTYPES
+                and src.bytes >= ctx.width_min_bytes):
+            out.append(_eqn_finding(
+                view, eqn, "width",
+                f"{view.name}:{src.dtype}->{dst}:{src.bytes}",
+                f"[{view.name}] {src.dtype} operand of {src.bytes} bytes "
+                f"widens to {dst} ({eqn.source or 'no frame'}) — "
+                f"{'key bits above 2**24 are lost' if dst == 'float32' else 'doubles the bytes of every downstream equation'}"
+                f"; keep the lane uint32 (mask/shift instead of "
+                f"promoting arithmetic)"))
+    return out
+
+
+@ir_rule("donation",
+         "large dead-after-use inputs must be donated "
+         "(concrete donate_argnums findings)",
+         "jx-donation")
+def rule_donation(view: ProgramView, ctx: AuditContext) -> List[Finding]:
+    out: List[Finding] = []
+    arg_of_leaf = view.meta.get("arg_of_leaf") or []
+    # outputs by (shape, dtype): an input aliasing an output is returned,
+    # not dead — conservative structural check (the engine's programs
+    # never pass inputs through)
+    out_shapes = {(o.shape, o.dtype) for o in view.out_avals}
+    missing_args = set()
+    for i, (aval, donated) in enumerate(zip(view.in_avals, view.donated)):
+        if donated or aval.bytes < ctx.donation_min_bytes:
+            continue
+        if (aval.shape, aval.dtype) in out_shapes:
+            continue
+        arg = arg_of_leaf[i] if i < len(arg_of_leaf) else None
+        missing_args.add((arg, i, aval))
+    for arg, i, aval in sorted(missing_args,
+                               key=lambda t: (t[0] is None, t[0], t[1])):
+        where = (f"python arg {arg}" if arg is not None
+                 else f"flat input {i}")
+        out.append(Finding(
+            rule="donation", path=f"jaxpr:{view.name}", line=0,
+            key=f"{view.name}:in{i}",
+            message=f"[{view.name}] {where} "
+                    f"({aval.dtype}{list(aval.shape)}, {aval.bytes} "
+                    f"bytes) is consumed, never returned, and not "
+                    f"donated — both generations stay live across the "
+                    f"dispatch; add donate_argnums=({arg},) at the "
+                    f"jax.jit site (operators.hash_join.split_donation "
+                    f"is the engine's donation map) or declare a "
+                    f"registry waiver with the reuse reason"))
+    return out

@@ -1,0 +1,253 @@
+"""graftcheck tracer: abstract entry-point registry + the jaxpr walker.
+
+Every jitted program the engine dispatches on the hot path is traced
+here with ``jax.make_jaxpr`` over ``ShapeDtypeStruct`` inputs — no
+arrays are materialized, no program compiles, no device executes, so
+the whole registry traces in a couple of seconds on the tier-1 CPU
+rig.  Downstream programs (the split probe, the bucket chain) take the
+*previous* program's outputs as inputs; ``jax.eval_shape`` over the
+producer supplies exactly the avals the engine would hand them, so the
+audited programs are the dispatched programs, not hand-modeled twins.
+
+Donation ground truth comes from ``operators.hash_join.split_donation``
+— the same table the ``jax.jit`` sites compile with — flattened across
+the pytree leaves so the donation rule checks what XLA was actually
+told.  Deliberately-undonated entries (the sizing program, the fused
+pipeline, the split shuffle: all re-fed by the retry/repeat loops)
+carry per-entry waivers with the reason inline, mirroring graftlint's
+``# lint: token-ok(reason)`` discipline at the registry level.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+
+from tpu_radix_join.analysis.core import LintError
+from tpu_radix_join.analysis.jaxpr.core import (AvalView, EqnView,
+                                                ProgramView)
+
+#: entry names build_entries understands, in dependency order
+ENTRY_NAMES = ("hist", "pipeline", "shuffle", "probe", "materialize_probe",
+               "lp", "bp", "bp_build", "bp_probe")
+
+#: primitives whose params carry nested jaxprs the walker must enter
+_REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", ".."))
+
+
+def _summarize(eqn) -> str:
+    """"path:line (function)" for the equation's staging site, repo-
+    relative when the frame is inside this repo; "" for framework
+    equations with no user frame."""
+    try:
+        from jax._src import source_info_util as siu
+        s = siu.summarize(eqn.source_info)
+    except Exception:       # noqa: BLE001 — attribution is best-effort
+        return ""
+    if s.startswith(_REPO_ROOT):
+        s = os.path.relpath(s, _REPO_ROOT)
+    return s
+
+
+def _mesh_axes(mesh) -> Dict[str, int]:
+    try:
+        return {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    except Exception:       # noqa: BLE001 — AbstractMesh variants differ
+        names = getattr(mesh, "axis_names", ()) or ()
+        sizes = getattr(mesh, "axis_sizes", ()) or ()
+        return {str(n): int(s) for n, s in zip(names, sizes)}
+
+
+def _sub_jaxprs(params: dict):
+    """Yield (open_jaxpr, mesh_or_None) for every nested jaxpr in an
+    equation's params — pjit/scan (ClosedJaxpr), cond (branches tuple),
+    shard_map (open jaxpr + mesh)."""
+    mesh = params.get("mesh") if "jaxpr" in params else None
+    for val in params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for v in vals:
+            # ClosedJaxpr also exposes .eqns — unwrap it first
+            if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"),
+                                               "eqns"):  # ClosedJaxpr
+                yield v.jaxpr, mesh
+            elif hasattr(v, "eqns"):                     # open Jaxpr
+                yield v, mesh
+
+
+def walk_eqns(jaxpr, mesh_axes: Optional[Dict[str, int]] = None,
+              depth: int = 0) -> List[EqnView]:
+    """Flatten a (Closed)Jaxpr to EqnViews, recursing through pjit/
+    shard_map/scan/cond bodies and threading the active mesh axes."""
+    if hasattr(jaxpr, "jaxpr"):
+        jaxpr = jaxpr.jaxpr
+    views: List[EqnView] = []
+    for eqn in jaxpr.eqns:
+        params = dict(eqn.params)
+        axes = dict(mesh_axes or {})
+        if eqn.primitive.name == "shard_map" and "mesh" in params:
+            axes.update(_mesh_axes(params["mesh"]))
+        views.append(EqnView(
+            prim=eqn.primitive.name,
+            invals=tuple(AvalView.of(v.aval) for v in eqn.invars),
+            outvals=tuple(AvalView.of(v.aval) for v in eqn.outvars),
+            params=params,
+            source=_summarize(eqn),
+            mesh_axes=dict(mesh_axes or {}),
+            depth=depth))
+        for sub, mesh in _sub_jaxprs(params):
+            sub_axes = dict(axes)
+            if mesh is not None:
+                sub_axes.update(_mesh_axes(mesh))
+            views.extend(walk_eqns(sub, sub_axes, depth + 1))
+    return views
+
+
+def flat_donated(args, donate_argnums: Sequence[int]) -> List[bool]:
+    """Per-flattened-leaf donation flags from python-arg donate_argnums."""
+    donated = set(donate_argnums)
+    flags: List[bool] = []
+    for i, arg in enumerate(args):
+        leaves = jax.tree_util.tree_leaves(arg)
+        flags.extend([i in donated] * len(leaves))
+    return flags
+
+
+def view_from_fn(name: str, fn, args, *, donate_argnums=(),
+                 waivers: Optional[Dict[str, str]] = None,
+                 num_devices: int = 1, meta: Optional[dict] = None
+                 ) -> ProgramView:
+    """Trace ``fn(*args)`` abstractly and package it for the IR rules."""
+    closed = jax.make_jaxpr(fn)(*args)
+    eqns = walk_eqns(closed)
+    mesh_axes: Dict[str, int] = {}
+    for e in eqns:
+        if e.prim == "shard_map" and "mesh" in e.params:
+            mesh_axes.update(_mesh_axes(e.params["mesh"]))
+    flat_in = [AvalView.of(v.aval) for v in closed.jaxpr.invars]
+    donated = flat_donated(args, donate_argnums)
+    arg_of_leaf: List[Optional[int]] = []
+    for i, arg in enumerate(args):
+        arg_of_leaf.extend([i] * len(jax.tree_util.tree_leaves(arg)))
+    if len(donated) != len(flat_in):
+        # consts prepend to invars in some traces; align conservatively
+        pad = len(flat_in) - len(donated)
+        if pad > 0:
+            donated = [False] * pad + donated
+            arg_of_leaf = [None] * pad + arg_of_leaf
+        else:
+            donated = donated[:len(flat_in)]
+            arg_of_leaf = arg_of_leaf[:len(flat_in)]
+    full_meta = dict(meta or {})
+    full_meta["arg_of_leaf"] = arg_of_leaf
+    return ProgramView(
+        name=name, eqns=eqns, in_avals=flat_in,
+        out_avals=[AvalView.of(v.aval) for v in closed.jaxpr.outvars],
+        donated=donated, mesh_axes=mesh_axes, num_devices=num_devices,
+        waivers=dict(waivers or {}), meta=full_meta, jaxpr=closed)
+
+
+# ------------------------------------------------------------ entry registry
+def _batch_sds(global_n: int):
+    import jax.numpy as jnp
+
+    from tpu_radix_join.data.tuples import TupleBatch
+    return TupleBatch(
+        key=jax.ShapeDtypeStruct((global_n,), jnp.uint32),
+        rid=jax.ShapeDtypeStruct((global_n,), jnp.uint32))
+
+
+#: reasons the front-half programs keep their inputs undonated — the
+#: donation rule's per-entry waivers (graftlint's ``-ok(reason)`` analog)
+_FRONT_HALF_WAIVERS = {
+    "hist": {"donation": "sizing program: r and s are re-fed to the "
+                         "pipeline program after capacity resolution"},
+    "pipeline": {"donation": "fused pipeline inputs survive the join: the "
+                             "capacity-regrow retry loop and pipelined "
+                             "repeats re-dispatch the same r/s buffers"},
+    "shuffle": {"donation": "split front half: r and s are the retry "
+                            "loop's regeneration source — a capacity "
+                            "retry reruns the shuffle from the pristine "
+                            "inputs"},
+}
+
+
+def build_entries(num_nodes: int = 8, per_node: int = 8192,
+                  cap: int = 2048,
+                  entries: Optional[Sequence[str]] = None
+                  ) -> List[ProgramView]:
+    """Trace the engine's jitted entry points into ProgramViews.
+
+    Builds two throwaway engines (sort-probe and bucket-probe) on the
+    first ``num_nodes`` local devices and traces each program with
+    representative static shapes (``per_node`` tuples/node, ``cap``
+    wire slots per (sender, destination) block — large enough that the
+    byte-threshold rules see hot-path-scale buffers).  Requires the
+    host to expose ``num_nodes`` devices (tests/conftest.py and the
+    audit CLI force 8 virtual CPU devices before importing jax).
+    """
+    from tpu_radix_join import HashJoin, JoinConfig
+    from tpu_radix_join.operators.hash_join import split_donation
+
+    if len(jax.devices()) < num_nodes:
+        raise LintError(
+            f"graftcheck needs {num_nodes} devices to build the engine "
+            f"mesh, found {len(jax.devices())} — force host CPU devices "
+            f"before importing jax (utils/platform.force_host_cpu_devices)")
+    wanted = list(entries) if entries is not None else list(ENTRY_NAMES)
+    unknown = [e for e in wanted if e not in ENTRY_NAMES]
+    if unknown:
+        raise LintError(f"unknown entry name(s): {', '.join(unknown)} "
+                        f"(known: {', '.join(ENTRY_NAMES)})")
+    n = num_nodes
+    rb, sb = _batch_sds(n * per_node), _batch_sds(n * per_node)
+    eng = HashJoin(JoinConfig(num_nodes=n, network_fanout_bits=5))
+    beng = HashJoin(JoinConfig(num_nodes=n, network_fanout_bits=5,
+                               probe_algorithm="bucket",
+                               local_fanout_bits=6))
+    views: List[ProgramView] = []
+    meta = {"num_nodes": n, "per_node": per_node, "cap": cap}
+
+    def add(name, fn, args, donate=(), waivers=None):
+        if name in wanted:
+            views.append(view_from_fn(
+                name, fn, args, donate_argnums=donate,
+                waivers=_FRONT_HALF_WAIVERS.get(name, waivers or {}),
+                num_devices=n, meta=dict(meta, entry=name)))
+
+    add("hist", eng._histogram_fn(0), (rb, sb))
+    add("pipeline", eng._pipeline_fn(per_node, per_node, cap, cap),
+        (rb, sb))
+    shuffle_fn = eng._shuffle_fn(cap, cap)
+    add("shuffle", shuffle_fn, (rb, sb))
+    if "probe" in wanted:
+        # (rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, sflags, s_gh)
+        outs = jax.eval_shape(shuffle_fn, rb, sb)
+        probe_args = tuple(outs[:5]) + tuple(outs[6:])
+        add("probe", eng._probe_fn(cap, cap, 1), probe_args,
+            donate=split_donation("probe"))
+    if "materialize_probe" in wanted:
+        mouts = jax.eval_shape(eng._shuffle_fn(cap, cap, materialize=True),
+                               rb, sb)
+        add("materialize_probe", eng._materialize_probe_fn(per_node),
+            (mouts[0], mouts[1]),
+            donate=split_donation("materialize_probe"))
+    if {"lp", "bp", "bp_build", "bp_probe"} & set(wanted):
+        bouts = jax.eval_shape(beng._shuffle_fn(cap, cap), rb, sb)
+        lp_args = tuple(bouts[:4])
+        lp_fn = beng._lp_fn(cap, cap, 1)
+        add("lp", lp_fn, lp_args, donate=split_donation("lp"))
+        louts = jax.eval_shape(lp_fn, *lp_args)
+        bp_args = (louts[0], louts[1])
+        add("bp", beng._bp_fn(cap, cap, 1), bp_args,
+            donate=split_donation("bp"))
+        build_fn = beng._bp_build_fn(cap, cap, 1, None, False)
+        add("bp_build", build_fn, bp_args,
+            donate=split_donation("bp_build"))
+        if "bp_probe" in wanted:
+            lanes = jax.eval_shape(build_fn, *bp_args)
+            add("bp_probe", beng._bp_probe_fn(cap, cap, 1, None, False),
+                tuple(lanes), donate=split_donation("bp_probe"))
+    return views

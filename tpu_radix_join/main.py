@@ -722,6 +722,44 @@ def main(argv=None) -> int:
     return rc
 
 
+def _plan_static_payload(profile, workload, plan, meas):
+    """graftcheck cross-validation for ``--plan explain``: trace the
+    fused pipeline at this workload's geometry and diff its all_to_all
+    bytes against the cost model (STATIC-DRIFT column), recording the
+    STATICMEM / JXAUDIT gauges.  Best-effort: tracing needs
+    ``num_nodes`` host devices — on any failure explain simply omits
+    the column rather than failing the driver."""
+    if plan is None or plan.engine != "incore":
+        return None
+    try:
+        from tpu_radix_join.analysis.jaxpr import run_audit
+        from tpu_radix_join.analysis.jaxpr.crossval import static_for_explain
+        from tpu_radix_join.analysis.jaxpr.trace import build_entries
+        from tpu_radix_join.performance.measurements import (JXAUDIT,
+                                                             STATICMEM)
+        from tpu_radix_join.planner.cost_model import plan_exchange
+
+        n = max(1, workload.num_nodes)
+        per_node = max(8, -(-max(workload.r_tuples, workload.s_tuples)
+                            // n))
+        cap = max(8, 1 << (-(-per_node // n) - 1).bit_length())
+        views = build_entries(num_nodes=n, per_node=per_node, cap=cap,
+                              entries=("pipeline",))
+        res = run_audit(views)
+        xplan = plan_exchange(profile, workload,
+                              fanout_bits=plan.network_fanout_bits)
+        payload = static_for_explain(views[0], xplan)
+        meas.counters[JXAUDIT] = len(res.findings)
+        peak = res.stats.get("pipeline", {}).get("peak_live_bytes")
+        if peak is not None:
+            meas.counters[STATICMEM] = int(peak)
+        return payload
+    except Exception as e:       # noqa: BLE001 — advisory column only
+        print(f"[PLAN] static cross-validation unavailable: {e}",
+              file=sys.stderr)
+        return None
+
+
 def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     """Driver body after flag/observability setup (main() wraps this in the
     tracer/sampler lifecycle so every exit path exports its timeline)."""
@@ -739,6 +777,7 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     plan_cache = None
     plan_costs = None
     explain_tbl = None
+    plan_static = None
     if args.plan is not None or args.plan_cache_dir:
         import dataclasses as _dc
 
@@ -772,8 +811,10 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
             if plan is None:
                 plan, costs = plan_join(profile, workload)
                 plan_costs, explain_tbl = costs, explain_table
+                plan_static = _plan_static_payload(profile, workload,
+                                                   plan, meas)
                 if args.plan == "explain":
-                    print(explain_table(costs, plan))
+                    print(explain_table(costs, plan, static=plan_static))
                     # constants half of explain: where each profile
                     # constant came from (fit provenance vs committed
                     # citation) and which ones the ledger's accumulated
@@ -948,7 +989,8 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
               f"drift={audit['drift_pct']:.1f}%")
         if plan_costs is not None and explain_tbl is not None:
             print(explain_tbl(plan_costs, plan,
-                              actuals=actuals_for_explain(audit)))
+                              actuals=actuals_for_explain(audit),
+                              static=plan_static))
     # per-rank failure class rides the registry meta into the rank-0
     # aggregate report (performance.print_results): a multi-rank run where
     # one rank degraded must say so in the summary, not only in that

@@ -107,7 +107,10 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # more live lint findings is strictly worse —
                             # a finding-count regression gates like a perf
                             # regression
-                            "lint_findings", "stale_baseline")
+                            "lint_findings", "stale_baseline",
+                            # graftcheck (tools_jaxpr_audit.py --json): live
+                            # IR-level findings gate the same way
+                            "jaxpr_findings")
 # Exact-name lower-is-better pins for the Measurements counter/timer
 # vocabulary (performance/measurements.py).  Historically these rode the
 # "unmatched tags default to cost" rule; the counter-tag lint rule
@@ -124,7 +127,8 @@ _COST_TAGS = {"JTOTAL", "JPROC", "JHIST", "JMPI", "JCOMPILE", "SWINALLOC",
               "QREJECT", "QDEADLINE", "QDEGRADED", "BRKTRIP",
               "VFAIL", "VREPAIR",
               "PARTPASS", "SORTPASS",
-              "MWINBYTES", "PACKRATIO"}
+              "MWINBYTES", "PACKRATIO",
+              "JXAUDIT"}
 # Explicitly neutral tags: workload/geometry descriptors with no
 # regression direction (tuple counts scale with the input, capacities
 # and stage counts describe the plan, chaos/checkpoint counters describe
@@ -135,7 +139,8 @@ NEUTRAL_TAGS = {"RTUPLES", "STUPLES", "RESULTS",
                 "MWINPUTCNT", "WINCAPR", "WINCAPS", "XSTAGES",
                 "BPBUILDTUPLES", "BPPROBETUPLES",
                 "VCHKN", "QADMIT", "BRKPROBE",
-                "FINJECT", "CKPTSAVE", "CKPTLOAD", "GRIDPAIRS"}
+                "FINJECT", "CKPTSAVE", "CKPTLOAD", "GRIDPAIRS",
+                "STATICMEM"}
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          "schema_version"}

@@ -113,6 +113,44 @@ class MaterializedJoinResult(NamedTuple):
     diagnostics: Optional[dict] = None
 
 
+def split_donation(program: str, skew: bool = False,
+                   wide: bool = False) -> tuple:
+    """``donate_argnums`` for the phase-split back-half programs.
+
+    The split pipeline's intermediate buffers (shuffled receive windows,
+    locally-partitioned bucket blocks, sorted bucket rows) are dead after
+    the next program consumes them: a capacity retry reruns the whole
+    attempt from the pristine ``r``/``s`` inputs (``_run_split``), never
+    from a stale intermediate.  Donating them lets XLA reuse that HBM for
+    the consumer's own temporaries instead of holding both generations
+    live across the program boundary — the fix graftcheck's ``donation``
+    rule demands (tools_jaxpr_audit.py).  The front-half programs
+    (histogram, shuffle, fused pipeline) deliberately do NOT donate:
+    their inputs are the retry loop's regeneration source and the
+    pipelined-repeat path re-feeds them, which the entry registry
+    (analysis/jaxpr/trace.py) records as reasoned waivers.
+
+    One definition shared by the ``jax.jit`` sites below and the
+    graftcheck entry registry, so the auditor checks the donation map
+    the engine actually compiles with.  The tiny replicated inputs
+    (``s_gh``: the [P] outer histogram) stay undonated — scalar-scale,
+    and replicated buffers cannot alias a sharded output anyway.
+    """
+    return {
+        # (rp_batch, rp_valid, sp_batch, sp_valid, sp_pid, [hot], s_gh)
+        "probe": tuple(range(6 if skew else 5)),
+        # (rp_batch, rp_valid, sp_batch, sp_valid, [hot])
+        "lp": tuple(range(5 if skew else 4)),
+        # (lr_blocks, ls_blocks)
+        "bp": (0, 1),
+        "bp_build": (0, 1),
+        # sorted bucket-row lanes (key rows [+ hi rows], weight rows)
+        "bp_probe": tuple(range(3 if wide else 2)),
+        # (rp_batch, sp_batch, [hot])
+        "materialize_probe": tuple(range(3 if skew else 2)),
+    }[program]
+
+
 def _as_compressed(batch: TupleBatch) -> CompressedBatch:
     """Identity-compression view: the sort probe compares full keys (safe
     across mixed partitions in the receive buffer; see network_partitioning
@@ -662,7 +700,8 @@ class HashJoin:
             in_specs = (spec, spec, spec, spec, spec, P())
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(spec, P(), P())))
+            out_specs=(spec, P(), P())),
+            donate_argnums=split_donation("probe", bool(skew_plan)))
 
     def _split_key(self, r: TupleBatch, s: TupleBatch, cap_r: int, cap_s: int,
                    skew_plan):
@@ -832,7 +871,9 @@ class HashJoin:
             in_specs = (spec, spec)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(spec, spec, spec, P())))
+            out_specs=(spec, spec, spec, P())),
+            donate_argnums=split_donation("materialize_probe",
+                                          bool(skew_plan)))
 
     def _run_split_materialize(self, r: TupleBatch, s: TupleBatch,
                                cap_r: int, cap_s: int, rate_cap: int,
@@ -937,7 +978,8 @@ class HashJoin:
             in_specs = (spec,) * 4
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=in_specs,
-            out_specs=(spec, spec, P())))
+            out_specs=(spec, spec, P())),
+            donate_argnums=split_donation("lp", bool(skew_plan)))
 
     def _bp_fn(self, cap_r: int, cap_s: int, local_slack: int,
                skew_plan=None):
@@ -956,7 +998,8 @@ class HashJoin:
         spec = P(ax)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec),
-            out_specs=(spec, P())))
+            out_specs=(spec, P())),
+            donate_argnums=split_donation("bp"))
 
     def _bucket_row_args(self, lr_blocks: TupleBatch, ls_blocks: TupleBatch,
                          lcap_r: int, lcap_s: int):
@@ -987,7 +1030,8 @@ class HashJoin:
         spec = P(ax)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec, spec),
-            out_specs=(spec,) * (3 if wide else 2)))
+            out_specs=(spec,) * (3 if wide else 2)),
+            donate_argnums=split_donation("bp_build"))
 
     def _bp_probe_fn(self, cap_r: int, cap_s: int, local_slack: int,
                      skew_plan, wide: bool):
@@ -1008,7 +1052,8 @@ class HashJoin:
         spec = P(ax)
         return jax.jit(jax.shard_map(
             body, mesh=self.mesh, in_specs=(spec,) * (3 if wide else 2),
-            out_specs=(spec, P())))
+            out_specs=(spec, P())),
+            donate_argnums=split_donation("bp_probe", wide=wide))
 
     @staticmethod
     def _count_risk(max_weight, s_hist) -> jnp.ndarray:

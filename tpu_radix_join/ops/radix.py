@@ -127,6 +127,10 @@ def local_histogram(pid: jnp.ndarray, num_partitions: int,
                            else "Pallas unavailable")
     weights = None if valid is None else valid.astype(jnp.uint32)
     if impl == "xla":
+        # bincount stages two scalar () device_put eqns (weak-typed
+        # bounds, ALIAS semantics — free on every backend); the jaxpr
+        # transfer rule's byte threshold (analysis/jaxpr/rules_ir.py)
+        # keeps them out of the audit while still catching bulk traffic
         hist = jnp.bincount(pid.astype(jnp.int32), weights=weights,
                             length=num_partitions)
         return hist.astype(jnp.uint32)

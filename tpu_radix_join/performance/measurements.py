@@ -112,6 +112,12 @@ RECOVERN = "RECOVERN"      # partitions recomputed during elastic recovery
                            # count means resume was partition-granular
 RECOVERMS = "RECOVERMS"    # total elastic-recovery wall milliseconds (detect ->
                            # re-plan -> recompute -> splice)
+JXAUDIT = "JXAUDIT"        # gauge: live graftcheck (jaxpr IR audit) findings
+                           # on the traced entry points — the static twin of
+                           # the lint gate; lower is better, clean repo holds 0
+STATICMEM = "STATICMEM"    # gauge: static live-set peak bytes of the traced
+                           # fused pipeline (analysis/jaxpr/memory.py) — plan
+                           # geometry descriptor feeding the feasibility gate
 NCOMPILE = "NCOMPILE"      # backend compiles observed via jax.monitoring
                            # (observability/compilemon.py); a resident serve
                            # session recompiling after warmup is a storm

@@ -33,15 +33,18 @@ from tpu_radix_join.planner.cache import PlanCache
 from tpu_radix_join.planner.calibrate import (UnderSampledError, detect_stale,
                                               diff_profiles, fit_profile)
 from tpu_radix_join.planner.cost_model import StrategyCost, Workload
-from tpu_radix_join.planner.plan import JoinPlan, explain_table, plan_join
+from tpu_radix_join.planner.plan import (JoinPlan, PlanError,
+                                         PlanInfeasibleError, explain_table,
+                                         plan_join, static_memory_gate)
 from tpu_radix_join.planner.profile import (DeviceProfile, calibrate,
                                             format_provenance, load_profile,
                                             resolve_profile)
 
 __all__ = [
-    "DeviceProfile", "JoinPlan", "PlanCache", "StrategyCost",
+    "DeviceProfile", "JoinPlan", "PlanCache", "PlanError",
+    "PlanInfeasibleError", "StrategyCost",
     "UnderSampledError", "Workload", "actuals_for_explain", "audit_plan",
     "calibrate", "detect_stale", "diff_profiles", "explain_table",
     "fit_profile", "format_provenance", "load_profile", "phase_snapshot",
-    "plan_join", "resolve_profile",
+    "plan_join", "resolve_profile", "static_memory_gate",
 ]

@@ -38,6 +38,17 @@ class PlanError(ValueError):
     """No feasible strategy, or a malformed plan file."""
 
 
+class PlanInfeasibleError(PlanError):
+    """The workload cannot fit the armed memory budget — refused at plan
+    time with a retry-taxonomy class so callers classify it like every
+    other failure (robustness/retry.py), instead of OOMing at dispatch.
+    Raised both by the analytic gate (no feasible cost row) and by the
+    graftcheck static-memory gate (traced live-set peak exceeds the
+    budget: ``static_memory_gate``)."""
+
+    failure_class = "plan_infeasible"   # == robustness.retry.PLAN_INFEASIBLE
+
+
 @dataclasses.dataclass(frozen=True)
 class JoinPlan:
     """The planner's decision, in driver vocabulary.
@@ -132,15 +143,54 @@ class JoinPlan:
 _fanout_bits = network_fanout_bits
 
 
-def plan_join(profile: DeviceProfile, workload: Workload
+def static_memory_gate(workload: Workload) -> int:
+    """graftcheck feasibility gate: trace the fused pipeline at this
+    workload's geometry (abstract — no arrays, no dispatch) and walk its
+    live set.  Returns the machine-wide static peak bytes; raises
+    :class:`PlanInfeasibleError` when the workload arms a
+    ``memory_budget_bytes`` the peak cannot fit — a *classified* refusal
+    at plan time where the analytic ``incore_resident_bytes`` gate (a
+    resident-set model) would have admitted the plan and the dispatch
+    would have OOMed on the transient live set.
+
+    Lazy-imports ``analysis.jaxpr`` (the planner stays importable
+    without tracing) and needs ``workload.num_nodes`` host devices."""
+    from tpu_radix_join.analysis.jaxpr.memory import peak_live_bytes
+    from tpu_radix_join.analysis.jaxpr.trace import build_entries
+
+    n = max(1, workload.num_nodes)
+    per_node = max(8, -(-max(workload.r_tuples, workload.s_tuples) // n))
+    cap = max(8, 1 << (-(-per_node // n) - 1).bit_length())
+    view = build_entries(num_nodes=n, per_node=per_node, cap=cap,
+                         entries=("pipeline",))[0]
+    peak = peak_live_bytes(view.jaxpr)
+    budget = workload.memory_budget_bytes
+    if budget is not None and peak > budget:
+        raise PlanInfeasibleError(
+            f"static-memory gate: the fused pipeline's traced live-set "
+            f"peak is {peak} bytes at {per_node} tuples/node x {n} nodes "
+            f"(wire cap {cap}), exceeding the armed memory budget "
+            f"{budget} bytes ({peak / max(1, budget):.2f}x) — refusing "
+            f"at plan time; shrink the workload, raise the budget, or "
+            f"route through the chunked engine")
+    return int(peak)
+
+
+def plan_join(profile: DeviceProfile, workload: Workload,
+              static_gate: bool = False
               ) -> Tuple[JoinPlan, List[StrategyCost]]:
     """Pick the cheapest feasible strategy (ties break toward the earlier
     row — fused before split, narrow before full) and bind it to driver
-    knobs."""
+    knobs.
+
+    ``static_gate=True`` additionally runs :func:`static_memory_gate`
+    on incore winners when the workload arms a memory budget — the
+    jaxpr-derived live-set check on top of the analytic resident-set
+    row gate."""
     costs = enumerate_strategies(profile, workload)
     feasible = [c for c in costs if c.feasible]
     if not feasible:
-        raise PlanError(
+        raise PlanInfeasibleError(
             "no feasible strategy for this workload — every cost row is "
             "infeasible:\n" + explain_table(costs))
     best = min(feasible, key=lambda c: c.cost_ms)
@@ -193,6 +243,9 @@ def plan_join(profile: DeviceProfile, workload: Workload
         if not fused:
             # the split cannot pipeline (fence per program)
             plan = dataclasses.replace(plan, pipeline_repeats=False)
+    if (static_gate and plan.engine == "incore"
+            and workload.memory_budget_bytes is not None):
+        static_memory_gate(workload)
     return plan, costs
 
 
@@ -204,14 +257,20 @@ def _narrow(w: Workload) -> bool:
 
 def explain_table(costs: List[StrategyCost],
                   chosen: Optional[JoinPlan] = None,
-                  actuals: Optional[dict] = None) -> str:
+                  actuals: Optional[dict] = None,
+                  static: Optional[dict] = None) -> str:
     """Human-readable per-strategy predicted-cost table (the ``--plan
     explain`` payload).  Terms are columns so a reader can line each up
     against the measured phase columns in a chip perf artifact.
 
     ``actuals`` (a plan-vs-actual audit summary — planner/audit.py
     ``actuals_for_explain``) adds measured ``actual_ms``/``drift%``
-    columns, filled on the row of the strategy that actually ran."""
+    columns, filled on the row of the strategy that actually ran.
+    ``static`` (a graftcheck cross-validation summary —
+    analysis/jaxpr/crossval.py ``static_for_explain``) adds the
+    ``STATIC-DRIFT`` column: jaxpr-derived exchange bytes/tuple vs the
+    cost model's ``bytes_per_tuple``, filled on the chosen row — an
+    execution-free grounding signal next to the runtime drift."""
     term_keys: List[str] = []
     for c in costs:
         for k in c.terms:
@@ -219,11 +278,12 @@ def explain_table(costs: List[StrategyCost],
                 term_keys.append(k)
     header = (["strategy", "feasible", "predicted_ms"]
               + (["actual_ms", "drift%"] if actuals else [])
+              + (["STATIC-DRIFT"] if static else [])
               + [f"{k}_ms" for k in term_keys] + ["note"])
     rows = []
     for c in costs:
-        mark = (" *" if chosen is not None and c.strategy == chosen.strategy
-                else "")
+        is_chosen = chosen is not None and c.strategy == chosen.strategy
+        mark = " *" if is_chosen else ""
         act_cells = []
         if actuals:
             if c.strategy == actuals.get("strategy"):
@@ -232,10 +292,16 @@ def explain_table(costs: List[StrategyCost],
                              f"{d:.1f}" if d is not None else "-"]
             else:
                 act_cells = ["", ""]
+        static_cells = []
+        if static:
+            sd = static.get("drift_pct")
+            static_cells = [f"{sd:+.2f}%" if is_chosen and sd is not None
+                            else ""]
         rows.append([c.strategy + mark,
                      "yes" if c.feasible else "NO",
                      f"{c.cost_ms:.1f}" if c.feasible else "-"]
                     + act_cells
+                    + static_cells
                     + [f"{c.terms[k]:.1f}" if k in c.terms else ""
                        for k in term_keys]
                     + [c.note])
@@ -262,4 +328,12 @@ def explain_table(costs: List[StrategyCost],
                    "xla": "(lax.sort emitter)"}.get(
                        chosen.sort_impl,
                        "(runtime auto-select per sort site)"))
+    if static:
+        lines.append(
+            f"static: jaxpr {static.get('entry', '?')} ships "
+            f"{static.get('static_bytes', 0)} B/node over "
+            f"{sum(static.get('collectives', {}).values())} collectives "
+            f"({static.get('static_bytes_per_tuple', 0.0):.3f} B/tuple "
+            f"vs plan {static.get('plan_bytes_per_tuple', 0.0):.3f}; "
+            f"drift {static.get('drift_pct', 0.0):+.2f}%)")
     return "\n".join(lines)
