@@ -914,6 +914,294 @@ def _run_recovery_bench(check_baseline=None, size=1 << 18):
     return 0
 
 
+def _run_recovery_straggle_bench(check_baseline=None, factor=4.0,
+                                 size=1 << 17):
+    """``--recovery-bench --straggle f``: the hedged-vs-unhedged tail A/B.
+
+    One rank of the 8-way host mesh stalls for ``f x straggle_unit_s``
+    mid-join (the ``compute.straggle`` site).  The **unhedged arm** eats
+    the stall in full — the whole join stretches by the slowest rank,
+    the reference's RMA-window failure mode.  The **hedged arm** lets the
+    relative-progress detector (robustness/straggler.py) flag the victim
+    off manifest progress and speculatively recomputes its unfinished
+    stripe through the manifest fence — first writer wins, so even a
+    late-finishing original could not double-count.  The manifest
+    pre-realizes every partition OUTSIDE the victim's stripe (the counts
+    a healthy rank would have posted pre-stall), so the hedge recompute
+    must stay partition-granular — a hedge that recomputes everything is
+    a veiled restart and exits 3 exactly like the shrink bench's gate.
+
+    Exit 3 unless both arms are oracle-exact, HEDGEWIN >= 1, the hedge
+    stayed partition-granular, the manifest audit sums to the oracle,
+    and the hedged tail beats the unhedged tail.  ``hedged_ms``/
+    ``unhedged_ms``/``specwaste`` gate lower-is-better, the headline
+    ``value`` (unhedged over hedged wall) higher-is-better."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import tempfile
+
+    import jax.numpy as jnp
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.operators.hash_join import HashJoin
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import (HEDGED, HEDGEWIN,
+                                                         RECOVERN, SPECWASTE)
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.robustness.checkpoint import PartitionManifest
+    from tpu_radix_join.robustness.membership import (LeaseBoard,
+                                                      MembershipView)
+
+    nodes, n = 8, size
+    cfg = JoinConfig(num_nodes=nodes, network_fanout_bits=5, verify="check")
+    num_p = cfg.network_partition_count
+    victim = nodes - 1                 # _compute_straggle's simulated victim
+    rng = np.random.default_rng(29)
+    rk = (rng.permutation(n) + 1).astype(np.uint32)
+    sk = rng.integers(1, n + 1, size=n).astype(np.uint32)
+    rid = np.arange(n, dtype=np.uint32)
+    r = TupleBatch(key=jnp.asarray(rk), rid=jnp.asarray(rid))
+    s = TupleBatch(key=jnp.asarray(sk), rid=jnp.asarray(rid))
+    true = np.bincount(sk & (num_p - 1), minlength=num_p)
+
+    tmp = tempfile.mkdtemp(prefix="straggle_bench_")
+    eng = HashJoin(cfg, measurements=Measurements(num_nodes=nodes))
+    eng.elastic = True
+    eng.straggle_factor = float(factor)
+    eng.straggle_unit_s = 0.25         # the stall the unhedged arm eats
+
+    def one_arm(tag, hedge):
+        man = PartitionManifest(os.path.join(tmp, f"m_{tag}.manifest"),
+                                fingerprint={"bench": "straggle"})
+        man.mark_many({p: int(true[p]) for p in range(num_p)
+                       if p % nodes != victim}, owner_of=lambda p: p % nodes)
+        m = Measurements(num_nodes=nodes)
+        board = LeaseBoard(os.path.join(tmp, f"leases_{tag}"), rank=0,
+                           num_ranks=1, lease_s=300.0, measurements=m)
+        membership = MembershipView(board, measurements=m)
+        board.heartbeat(0)
+        eng.measurements = m
+        eng.partition_manifest = man
+        eng.membership = membership
+        eng.hedge = hedge
+        try:
+            with faults.FaultInjector(seed=7, measurements=m).arm(
+                    faults.COMPUTE_STRAGGLE, at=1):
+                t0 = time.perf_counter()
+                out = eng.join_arrays(r, s)
+                wall_ms = (time.perf_counter() - t0) * 1e3
+        finally:
+            eng.partition_manifest = None
+            eng.membership = None
+            eng.hedge = "off"
+        return out, wall_ms, m, man
+
+    one_arm("warm_off", "off")         # compile-warm the plain join
+    one_arm("warm_on", "on")           # compile-warm the masked grids
+    out_u, unhedged_ms, _, _ = one_arm("timed_off", "off")
+    out_h, hedged_ms, mh, man_h = one_arm("timed_on", "on")
+    recovern = int(mh.counters.get(RECOVERN, 0))
+    hedgewin = int(mh.counters.get(HEDGEWIN, 0))
+    aud = man_h.audit()
+    for tag, out in (("unhedged", out_u), ("hedged", out_h)):
+        if not (out.ok and out.matches == n):
+            print(f"ERROR: {tag} arm missed the oracle: {out.matches} "
+                  f"!= {n}", file=sys.stderr)
+            return 3
+    if int(mh.counters.get(HEDGED, 0)) < 1 or hedgewin < 1:
+        print(f"ERROR: the hedge never engaged or never won a fence: "
+              f"HEDGED={int(mh.counters.get(HEDGED, 0))} "
+              f"HEDGEWIN={hedgewin}", file=sys.stderr)
+        return 3
+    if not 0 < recovern < num_p:
+        print(f"ERROR: hedge recompute was not partition-granular (a "
+              f"veiled restart): RECOVERN={recovern} of {num_p} "
+              f"partitions", file=sys.stderr)
+        return 3
+    if aud["total"] != n:
+        print(f"ERROR: manifest audit does not sum to the oracle: "
+              f"{aud['total']} != {n} "
+              f"(fenced_duplicates={aud['fenced_duplicates']})",
+              file=sys.stderr)
+        return 3
+    speedup = unhedged_ms / max(hedged_ms, 1e-9)
+    if speedup <= 1.0:
+        print(f"ERROR: hedged arm was not faster: {hedged_ms:.0f} ms "
+              f"hedged vs {unhedged_ms:.0f} ms unhedged", file=sys.stderr)
+        return 3
+    print(f"note: straggle x{factor}: hedged {hedged_ms:.0f} ms "
+          f"({recovern}/{num_p} partitions speculated, {hedgewin} fence "
+          f"wins) vs unhedged {unhedged_ms:.0f} ms -> {speedup:.2f}x",
+          file=sys.stderr)
+
+    result = {
+        "metric": "straggler_hedge_tail_speedup",
+        "value": round(speedup, 3),
+        "unit": "unhedged_tail_over_hedged_tail",
+        "size": n,
+        "num_partitions": num_p,
+        "straggle_factor": float(factor),
+        "hedged_ms": round(hedged_ms, 1),
+        "unhedged_ms": round(unhedged_ms, 1),
+        "hedgewin": hedgewin,
+        "specwaste": int(mh.counters.get(SPECWASTE, 0)),
+        "recovern": recovern,
+        "manifest_total": int(aud["total"]),
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
+def _run_recovery_grow_bench(check_baseline=None, size=1 << 19):
+    """``--recovery-bench --grow``: mid-run admission speedup vs fixed
+    survivors.
+
+    Scenario: a join is mid-flight with 14 of the 32 partitions realized
+    in the manifest when a ninth process writes a ``joining`` lease; the
+    board admits it with a fenced epoch bump (the REAL admission path —
+    MembershipView.check over a lease dir, RANKJOIN ticks) and the
+    recovery plan re-expands `load_aware_assignment` over the enlarged
+    membership.  Both arms recompute the same 18 unfinished partitions
+    through `execute_recovery(only_rank=...)` per survivor; the reported
+    wall is the **critical path** — the slowest single survivor's share,
+    which is what decides when a data-parallel epoch completes.  The
+    fixed arm spreads 18 partitions over 8 survivors (max share 3), the
+    grown arm over 9 (max share 2).
+
+    Exit 3 unless the merged count is oracle-exact on both arms, the
+    recompute stayed partition-granular (the veiled-restart refusal the
+    shrink bench pioneered: resumed > 0 and recomputed < num_p), and the
+    grown critical path beats the fixed one.  ``grown_ms``/``fixed_ms``
+    gate lower-is-better; ``value`` (fixed over grown) higher-is-better."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import tempfile
+
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import RANKJOIN, RECOVERN
+    from tpu_radix_join.robustness.checkpoint import PartitionManifest
+    from tpu_radix_join.robustness.membership import (LeaseBoard,
+                                                      MembershipView)
+    from tpu_radix_join.robustness.recovery import (execute_recovery,
+                                                    partition_weights,
+                                                    plan_recovery)
+
+    nodes, n = 8, size
+    cfg = JoinConfig(num_nodes=nodes, network_fanout_bits=5, verify="check")
+    num_p = cfg.network_partition_count
+    rng = np.random.default_rng(31)
+    rk = (rng.permutation(n) + 1).astype(np.uint32)
+    sk = rng.integers(1, n + 1, size=n).astype(np.uint32)
+    true = np.bincount(sk & (num_p - 1), minlength=num_p)
+    realized = list(range(14))             # partitions done pre-admission
+    weights = partition_weights(rk, sk, num_p)
+
+    # -- the admission itself rides the real lease protocol: incumbents
+    # hold member leases, the newcomer writes a joining lease, one
+    # check() batch admits it with the fenced epoch bump
+    tmp = tempfile.mkdtemp(prefix="grow_bench_")
+    m = Measurements(num_nodes=nodes)
+    lease_dir = os.path.join(tmp, "leases")
+    for incumbent in range(nodes):
+        LeaseBoard(lease_dir, rank=incumbent, num_ranks=nodes,
+                   lease_s=300.0).heartbeat(0)
+    board = LeaseBoard(lease_dir, rank=0, num_ranks=nodes, lease_s=300.0,
+                       measurements=m)
+    joiner_rank = LeaseBoard.next_rank(lease_dir, floor=nodes)
+    LeaseBoard(lease_dir, rank=joiner_rank, num_ranks=nodes,
+               lease_s=300.0).heartbeat(0, status="joining")
+    mv = MembershipView(board, measurements=m)
+    mv.check()
+    if joiner_rank not in mv.joined or mv.epoch != 1:
+        print(f"ERROR: admission did not land: joined={sorted(mv.joined)} "
+              f"epoch={mv.epoch}", file=sys.stderr)
+        return 3
+    rankjoin = int(m.counters.get(RANKJOIN, 0))
+
+    def one_arm(tag, joined_ranks):
+        man = PartitionManifest(os.path.join(tmp, f"m_{tag}.manifest"),
+                                fingerprint={"bench": "grow"})
+        man.mark_many({p: int(true[p]) for p in realized},
+                      owner_of=lambda p: p % nodes)
+        plan = plan_recovery(num_nodes=nodes, num_partitions=num_p,
+                             lost_ranks=[], epoch=mv.epoch, manifest=man,
+                             weights=weights, joined_ranks=joined_ranks)
+        am = Measurements(num_nodes=nodes)
+        critical_ms, matches = 0.0, 0
+        for survivor in plan.survivors:
+            t0 = time.perf_counter()
+            matches, _ = execute_recovery(plan, rk, sk,
+                                          only_rank={survivor},
+                                          manifest=man, measurements=am)
+            critical_ms = max(critical_ms,
+                              (time.perf_counter() - t0) * 1e3)
+        return plan, critical_ms, matches, int(
+            am.counters.get(RECOVERN, 0)), man
+
+    one_arm("warm", ())                    # compile-warm the masked grids
+    plan_f, fixed_ms, matches_f, recovern_f, _ = one_arm("fixed", ())
+    plan_g, grown_ms, matches_g, recovern_g, man_g = one_arm(
+        "grown", sorted(mv.joined))
+    for tag, matches in (("fixed", matches_f), ("grown", matches_g)):
+        if matches != n:
+            print(f"ERROR: {tag} arm missed the oracle: {matches} != {n}",
+                  file=sys.stderr)
+            return 3
+    for tag, recovern in (("fixed", recovern_f), ("grown", recovern_g)):
+        if not (len(realized) > 0 and 0 < recovern < num_p):
+            print(f"ERROR: {tag} arm recompute was not partition-granular "
+                  f"(a veiled restart): RECOVERN={recovern} of {num_p} "
+                  f"partitions, {len(realized)} resumed", file=sys.stderr)
+            return 3
+    if joiner_rank not in set(plan_g.reassignment.values()):
+        print(f"ERROR: the grown plan never assigned the newcomer "
+              f"(rank {joiner_rank}) a partition: "
+              f"{plan_g.reassignment}", file=sys.stderr)
+        return 3
+    speedup = fixed_ms / max(grown_ms, 1e-9)
+    if speedup <= 1.0:
+        print(f"ERROR: grown arm was not faster: {grown_ms:.0f} ms grown "
+              f"vs {fixed_ms:.0f} ms fixed", file=sys.stderr)
+        return 3
+    print(f"note: join-mid-run: grown critical path {grown_ms:.0f} ms "
+          f"({len(plan_g.survivors)} survivors) vs fixed {fixed_ms:.0f} ms "
+          f"({len(plan_f.survivors)}) -> {speedup:.2f}x",
+          file=sys.stderr)
+
+    result = {
+        "metric": "elastic_grow_speedup",
+        "value": round(speedup, 3),
+        "unit": "fixed_critical_path_over_grown",
+        "size": n,
+        "num_partitions": num_p,
+        "grown_ms": round(grown_ms, 1),
+        "fixed_ms": round(fixed_ms, 1),
+        "recovern": recovern_g,
+        "resumed_partitions": len(realized),
+        "rankjoin": rankjoin,
+        "survivors_fixed": len(plan_f.survivors),
+        "survivors_grown": len(plan_g.survivors),
+        "manifest_total": int(man_g.audit()["total"]),
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
@@ -1003,7 +1291,21 @@ def main():
     if "--recovery-bench" in argv:
         # elastic-recovery A/B (robustness/recovery.py): CPU-sized like
         # --chaos/--grid-bench — it gates kill-1-of-8 partition-level
-        # recovery against the cold restart, not chip throughput
+        # recovery against the cold restart, not chip throughput.
+        # --grow switches to the mid-run-admission-vs-fixed-survivors
+        # arm; --straggle f to the hedged-vs-unhedged tail arm at
+        # slowdown factor f (robustness/straggler.py)
+        if "--grow" in argv:
+            sys.exit(_run_recovery_grow_bench(check_baseline))
+        if "--straggle" in argv:
+            i = argv.index("--straggle")
+            try:
+                factor = float(argv[i + 1])
+            except (IndexError, ValueError):
+                print("error: --straggle needs a numeric slowdown factor",
+                      file=sys.stderr)
+                sys.exit(2)
+            sys.exit(_run_recovery_straggle_bench(check_baseline, factor))
         sys.exit(_run_recovery_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):

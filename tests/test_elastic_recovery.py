@@ -54,14 +54,56 @@ def test_lapse_detection_and_startup_grace(tmp_path):
     clk.t += 4.0
     assert a.lapsed() == []            # inside the window
     clk.t += 2.0
-    assert a.lapsed() == [1]           # aged out
+    assert a.lapsed() == []            # one missed beat is not a lapse
+    clk.t += 5.0
+    assert a.lapsed() == [1]           # two windows of silence: aged out
     # startup grace: a rank that never wrote a lease lapses only once a
-    # full window has passed since board creation
+    # full lapse window (missed_beats x lease_s) passed since creation
     c = LeaseBoard(str(tmp_path / "g"), rank=0, num_ranks=2, lease_s=5.0,
                    clock=clk)
     assert c.lapsed() == []
     clk.t += 6.0
+    assert c.lapsed() == []            # inside the two-beat grace
+    clk.t += 5.0
     assert c.lapsed() == [1]
+
+
+def test_one_missed_beat_never_lapses(tmp_path):
+    """Regression for the false-lapse bug (satellite of the elastic-growth
+    PR): a healthy rank that misses ONE beat — a long device pass — used
+    to be declared lost at ``lease_s``; the two-missed-beats rule holds
+    the verdict until a second consecutive window passes in silence, and
+    a beat anywhere inside the window fully resets the clock."""
+    from tpu_radix_join.performance.measurements import RANKLOST, Measurements
+    clk = FakeClock()
+    m = Measurements()
+    a = LeaseBoard(str(tmp_path), rank=0, num_ranks=2, lease_s=5.0, clock=clk)
+    b = LeaseBoard(str(tmp_path), rank=1, num_ranks=2, lease_s=5.0, clock=clk)
+    view = MembershipView(a, measurements=m)
+    b.heartbeat()
+    # one whole window of silence (the slow-kernel scenario): no lapse
+    clk.t += 7.0
+    assert view.check() == []
+    assert view.lost == set() and m.counters.get(RANKLOST, 0) == 0
+    # a beat just before the second window closes resets everything
+    clk.t += 2.9
+    b.heartbeat()
+    clk.t += 9.9
+    assert view.check() == []          # inside a fresh 2-window span
+    # genuine death: silence past the full lapse window declares it
+    clk.t += 0.2
+    assert view.check() == [1]
+    assert m.counters[RANKLOST] == 1
+    # missed_beats=1 restores the old single-window policy explicitly
+    c = LeaseBoard(str(tmp_path / "one"), rank=0, num_ranks=2, lease_s=5.0,
+                   clock=clk, missed_beats=1)
+    d = LeaseBoard(str(tmp_path / "one"), rank=1, num_ranks=2, lease_s=5.0,
+                   clock=clk)
+    d.heartbeat()
+    clk.t += 5.1
+    assert c.lapsed() == [1]
+    with pytest.raises(ValueError):
+        LeaseBoard(str(tmp_path), rank=0, num_ranks=2, missed_beats=0)
 
 
 def test_torn_lease_reads_as_absent(tmp_path):
@@ -83,7 +125,7 @@ def test_membership_one_epoch_bump_per_batch(tmp_path):
         LeaseBoard(str(tmp_path), rank=r, num_ranks=4, lease_s=5.0,
                    clock=clk).heartbeat()
     assert view.check() == []
-    clk.t += 10.0                      # all three peers lapse together
+    clk.t += 11.0                      # all three peers lapse together
     assert view.check() == [1, 2, 3]
     assert view.epoch == 1             # ONE fence for the batch
     assert m.counters[MEPOCH] == 1 and m.counters[RANKLOST] == 3
@@ -114,7 +156,7 @@ def test_suspect_triage(tmp_path):
     peer.heartbeat()
     view = MembershipView(board)
     assert view.suspect() is None      # all peers live: hang verdict stands
-    clk.t += 10.0
+    clk.t += 11.0
     exc = view.suspect()
     assert isinstance(exc, RankLost) and exc.rank == 1
     assert exc.bundle_extra["membership_epoch"] == 1
@@ -391,27 +433,339 @@ def test_membership_epoch_fences_compile_cache(elastic_engine):
     assert fp0["membership_epoch"] == elastic_engine._membership_epoch()
 
 
+# ----------------------------------------------------------- rank admission
+def test_admission_exactly_once_per_batch(tmp_path):
+    """Two newcomers' joining leases land in one check() window: the
+    board admits BOTH with ONE fenced epoch bump (a host bringing up
+    several processes joins in one fence, not N), and the next check is
+    a no-op."""
+    from tpu_radix_join.performance.measurements import (MEPOCH, RANKJOIN,
+                                                         Measurements)
+    clk = FakeClock()
+    m = Measurements()
+    board = LeaseBoard(str(tmp_path), rank=0, num_ranks=2, lease_s=5.0,
+                       clock=clk, measurements=m)
+    peer = LeaseBoard(str(tmp_path), rank=1, num_ranks=2, lease_s=5.0,
+                      clock=clk)
+    board.heartbeat(0)
+    peer.heartbeat(0)
+    mv = MembershipView(board, measurements=m)
+    for r in (2, 3):
+        LeaseBoard(str(tmp_path), rank=r, num_ranks=2, lease_s=5.0,
+                   clock=clk).heartbeat(0, status="joining")
+    assert mv.check() == []            # returns losses; none here
+    assert mv.joined == {2, 3}
+    assert mv.epoch == 1               # ONE bump for the batch of two
+    assert m.counters[RANKJOIN] == 2 and m.counters[MEPOCH] == 1
+    assert mv.check() == []            # idempotent: nothing new to admit
+    assert mv.epoch == 1
+    assert mv.survivors == [0, 1, 2, 3]
+
+
+def test_lost_rank_readmits_only_via_joining_lease(tmp_path):
+    """A declared-lost rank's in-flight state is gone: a bare member
+    lease from it must NOT silently re-enter the current epoch — the
+    joining lease is the only door back in, at a NEW epoch."""
+    clk = FakeClock()
+    board = LeaseBoard(str(tmp_path), rank=0, num_ranks=2, lease_s=5.0,
+                       clock=clk)
+    peer = LeaseBoard(str(tmp_path), rank=1, num_ranks=2, lease_s=5.0,
+                      clock=clk)
+    board.heartbeat(0)
+    peer.heartbeat(0)
+    mv = MembershipView(board)
+    clk.t += 11.0
+    board.heartbeat(0)
+    assert mv.check() == [1]
+    assert mv.epoch == 1 and 1 in mv.lost
+    peer.heartbeat(1)                  # zombie writes a member lease
+    assert mv.check() == []
+    assert 1 in mv.lost and mv.epoch == 1
+    peer.heartbeat(1, status="joining")
+    mv.check()
+    assert mv.is_live(1) and 1 in mv.joined
+    assert mv.epoch == 2               # readmitted at a NEW fence
+
+
+def test_stale_joining_lease_never_admitted(tmp_path):
+    """A joiner that died before admission ages out of its request: its
+    joining lease older than the lapse window is skipped, a fresh beat
+    is admitted."""
+    clk = FakeClock()
+    board = LeaseBoard(str(tmp_path), rank=0, num_ranks=1, lease_s=5.0,
+                       clock=clk)
+    board.heartbeat(0)
+    mv = MembershipView(board)
+    joiner = LeaseBoard(str(tmp_path), rank=1, num_ranks=1, lease_s=5.0,
+                        clock=clk)
+    joiner.heartbeat(0, status="joining")
+    clk.t += 11.0                      # the joiner went silent
+    board.heartbeat(0)
+    mv.check()
+    assert mv.joined == set() and mv.epoch == 0
+    joiner.heartbeat(0, status="joining")
+    mv.check()
+    assert mv.joined == {1} and mv.epoch == 1
+
+
+def test_joiner_sync_epoch_adopts_incumbent_fence(tmp_path):
+    """A newcomer booted at epoch 0 catches up with whatever fences the
+    incumbents already burned — and never rewinds."""
+    LeaseBoard(str(tmp_path), rank=0, num_ranks=2, lease_s=5.0).heartbeat(3)
+    board = LeaseBoard(str(tmp_path), rank=2, num_ranks=2, lease_s=5.0)
+    board.heartbeat(0, status="joining")
+    mv = MembershipView(board)
+    assert mv.sync_epoch() == 3
+    assert mv.sync_epoch() == 3
+
+
+def test_heartbeat_carries_partitions_done(tmp_path):
+    """The progress clock rides the lease: ``progress_of`` stamps every
+    beat with manifest progress, and board_progress omits ranks that
+    export none (-1)."""
+    from tpu_radix_join.robustness.straggler import board_progress
+    a = LeaseBoard(str(tmp_path), rank=0, num_ranks=2, lease_s=5.0)
+    b = LeaseBoard(str(tmp_path), rank=1, num_ranks=2, lease_s=5.0)
+    a.progress_of = lambda: 7
+    a.heartbeat(0)
+    b.heartbeat(0)                     # no progress hook: -1
+    assert a.read(0).partitions_done == 7
+    assert a.read(1).partitions_done == -1
+    assert board_progress(a, [0, 1]) == {0: 7}
+
+
+# ------------------------------------------------------ manifest hedge fence
+def test_manifest_fence_late_original_loses_to_hedge(tmp_path):
+    """Direction one: the hedge realizes a partition first; the
+    straggling original's later done-line is fenced — the audit flags
+    one fenced duplicate and the total counts the partition ONCE."""
+    man = PartitionManifest(str(tmp_path / "m"), fingerprint={"t": 2})
+    man.mark_done(3, 111, 5, epoch=1)      # the hedge's writer, first
+    man.mark_done(3, 111, 7, epoch=1)      # the late original
+    rec = man.completed()[3]
+    assert rec["owner"] == 5 and rec["count"] == 111
+    aud = man.audit()
+    assert aud["total"] == 111
+    assert aud["fenced_duplicates"] == {3: 1}
+
+
+def test_manifest_fence_hedge_after_original_loses(tmp_path):
+    """Direction two: the original landed first, so a hedge claim on the
+    done partition is refused and a late hedge done-line is fenced."""
+    man = PartitionManifest(str(tmp_path / "m"), fingerprint={"t": 3})
+    man.mark_done(3, 40, 7, epoch=1)
+    assert man.claim(3, owner=5, epoch=1) is False
+    man.mark_done(3, 40, 5, epoch=1)       # the hedge writes anyway
+    assert man.completed()[3]["owner"] == 7
+    aud = man.audit()
+    assert aud["total"] == 40              # never double-counted
+    assert aud["fenced_duplicates"] == {3: 1}
+
+
+def test_manifest_claim_protocol(tmp_path):
+    """Claims are advisory intent lines: first claimant holds within an
+    epoch (idempotently for itself), a newer epoch supersedes, and done
+    lines — not claims — remain the count arbiter."""
+    man = PartitionManifest(str(tmp_path / "m"), fingerprint={"t": 4})
+    assert man.claim(2, owner=4, epoch=1) is True
+    assert man.claim(2, owner=4, epoch=1) is True
+    assert man.claim(2, owner=6, epoch=1) is False
+    assert man.claims()[2]["owner"] == 4
+    assert man.claim(2, owner=6, epoch=2) is True   # newer epoch supersedes
+    man.mark_done(2, 9, 4, epoch=1)
+    man.mark_done(2, 12, 6, epoch=2)
+    rec = man.completed()[2]
+    assert rec["owner"] == 6 and rec["count"] == 12
+
+
+# ---------------------------------------------------------- straggler detector
+def test_straggler_detector_validation_and_dwell():
+    from tpu_radix_join.robustness.straggler import StragglerDetector
+    with pytest.raises(ValueError):
+        StragglerDetector(threshold=0.0)
+    with pytest.raises(ValueError):
+        StragglerDetector(threshold=1.0)
+    with pytest.raises(ValueError):
+        StragglerDetector(dwell_checks=0)
+    det = StragglerDetector(threshold=0.5, min_outstanding=2,
+                            dwell_checks=2)
+    prog, todo = {0: 10, 1: 10, 2: 1}, {2: 4}
+    assert det.observe(prog, todo) is None     # dwell 1 of 2
+    v = det.observe(prog, todo)
+    assert v is not None and v.rank == 2
+    assert v.median == 10.0 and v.outstanding == 4
+    exc = v.to_exc(epoch=3)
+    assert exc.rank == 2 and exc.epoch == 3 and exc.outstanding == 4
+
+
+def test_straggler_detector_resets_and_guards():
+    from tpu_radix_join.robustness.straggler import StragglerDetector
+    det = StragglerDetector(threshold=0.5, dwell_checks=2)
+    assert det.observe({0: 10, 2: 1}, {2: 5}) is None
+    # the suspect catches up: the dwell streak resets
+    assert det.observe({0: 10, 2: 9}, {2: 5}) is None
+    assert det.observe({0: 10, 2: 1}, {2: 5}) is None
+    # nearly-done stragglers are not worth hedging (min_outstanding)
+    det2 = StragglerDetector(threshold=0.5, dwell_checks=1,
+                             min_outstanding=2)
+    assert det2.observe({0: 10, 2: 1}, {2: 1}) is None
+    # a lone rank has no peers to be relative to; zero median is too early
+    assert det2.observe({0: 0}, {0: 8}) is None
+    assert det2.observe({0: 0, 1: 0}, {0: 8}) is None
+
+
+def test_straggler_detector_tie_breaks_smallest_rank():
+    from tpu_radix_join.robustness.straggler import StragglerDetector
+    det = StragglerDetector(threshold=0.6, dwell_checks=1)
+    v = det.observe({3: 1, 1: 1, 0: 10, 2: 10}, {1: 9, 3: 9})
+    assert v is not None and v.rank == 1
+
+
+def test_score_hedge_splits_wins_from_waste(tmp_path):
+    from tpu_radix_join.performance.measurements import (HEDGEWIN,
+                                                         SPECWASTE,
+                                                         Measurements)
+    from tpu_radix_join.robustness.straggler import score_hedge
+    man = PartitionManifest(str(tmp_path / "m"), fingerprint={"t": 5})
+    man.mark_done(0, 5, 2, epoch=1)        # hedge writer won
+    man.mark_done(1, 5, 3, epoch=1)        # the straggler landed first
+    m = Measurements()
+    sc = score_hedge(man, [0, 1, 4], straggler=3, measurements=m)
+    assert sc == {"hedgewin": 1, "specwaste": 1}   # partition 4: no winner yet
+    assert m.counters[HEDGEWIN] == 1 and m.counters[SPECWASTE] == 1
+
+
+# ------------------------------------------------------ engine hedge + regrow
+def _fresh_elastic_engine():
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.operators.hash_join import HashJoin
+    cfg = JoinConfig(num_nodes=4, network_fanout_bits=3, verify="check")
+    eng = HashJoin(cfg)
+    eng.elastic = True
+    return eng
+
+
+def test_engine_hedges_injected_straggler(tmp_path):
+    """compute.straggle with hedge on: the detector flags the victim off
+    manifest progress, its stripe is speculatively recomputed through the
+    fence, the result is oracle-exact with NO epoch bump (the straggler
+    stays a member — nothing was declared lost) and the manifest audit
+    sums exactly to the oracle."""
+    from tpu_radix_join.performance.measurements import (HEDGED, HEDGEWIN,
+                                                         MEPOCH, RANKLOST,
+                                                         Measurements)
+    n = 1 << 11
+    r, s, _, _ = _oracle_batches(n, seed=3)
+    eng = _fresh_elastic_engine()
+    m = Measurements()
+    eng.measurements = m
+    board = LeaseBoard(str(tmp_path / "leases"), rank=0, num_ranks=1,
+                       lease_s=300.0, measurements=m)
+    board.heartbeat(0)
+    eng.membership = MembershipView(board, measurements=m)
+    man = PartitionManifest(str(tmp_path / "m"), fingerprint={"t": 6},
+                            measurements=m)
+    eng.partition_manifest = man
+    eng.hedge = "on"
+    eng.straggle_factor = 3.0
+    eng.straggle_unit_s = 0.05
+    with faults.FaultInjector(seed=11, measurements=m).arm(
+            faults.COMPUTE_STRAGGLE, at=1):
+        result = eng.join_arrays(r, s)
+    assert result.ok and result.matches == n
+    d = result.diagnostics
+    assert d["recovered"] is True and d.get("hedged") is True
+    assert m.counters[HEDGED] == 1
+    assert m.counters.get(HEDGEWIN, 0) >= 1
+    assert m.counters.get(MEPOCH, 0) == 0      # no fence: the rank lives
+    assert m.counters.get(RANKLOST, 0) == 0
+    assert man.audit()["total"] == n
+
+
+def test_engine_hedge_off_sleeps_out_the_straggle(tmp_path):
+    """The control arm: hedge off absorbs the injected slowdown as plain
+    tail latency — no recovery, no epoch bump, same exact count."""
+    from tpu_radix_join.performance.measurements import (HEDGED,
+                                                         Measurements)
+    n = 1 << 11
+    r, s, _, _ = _oracle_batches(n, seed=3)
+    eng = _fresh_elastic_engine()
+    m = Measurements()
+    eng.measurements = m
+    eng.straggle_factor = 2.0
+    eng.straggle_unit_s = 0.01
+    with faults.FaultInjector(seed=11, measurements=m).arm(
+            faults.COMPUTE_STRAGGLE, at=1):
+        result = eng.join_arrays(r, s)
+    assert result.ok and result.matches == n
+    assert not (result.diagnostics or {}).get("recovered")
+    assert m.counters.get(HEDGED, 0) == 0
+
+
+def test_engine_regrows_on_injected_rank_join(tmp_path):
+    """membership.rank_join with elastic_grow: the injected newcomer's
+    joining lease is admitted at the next boundary, the epoch fences
+    once, and the re-expanded plan assigns partitions to node ids beyond
+    the boot mesh — oracle-exact."""
+    from tpu_radix_join.performance.measurements import (MEPOCH, RANKJOIN,
+                                                         Measurements)
+    n = 1 << 11
+    r, s, _, _ = _oracle_batches(n, seed=4)
+    eng = _fresh_elastic_engine()
+    eng.elastic_grow = True
+    m = Measurements()
+    eng.measurements = m
+    board = LeaseBoard(str(tmp_path / "leases"), rank=0, num_ranks=1,
+                       lease_s=300.0, measurements=m)
+    board.heartbeat(0)
+    eng.membership = MembershipView(board, measurements=m)
+    with faults.FaultInjector(seed=13, measurements=m).arm(
+            faults.RANK_JOIN, at=1):
+        result = eng.join_arrays(r, s)
+    assert result.ok and result.matches == n
+    d = result.diagnostics
+    assert d["recovered"] is True and d.get("regrown") is True
+    assert d["lost_ranks"] == []
+    assert m.counters[RANKJOIN] == 1 and m.counters[MEPOCH] == 1
+    # the enlarged membership really received work: owners beyond the
+    # boot mesh appear in the re-expanded assignment
+    owners = {int(o) for o in d["recovery_assignment"].values()}
+    assert max(owners) >= 4
+
+
 # ------------------------------------------------------------ chaos mini-soak
 def test_recovery_mini_soak_fixed_seeds():
-    """Acceptance gate: rank-death schedules at every phase boundary end
-    oracle-exact (PASS, recovered) or classified — zero violations, zero
-    watchdog deaths, and at least one actual recovery in the batch."""
+    """Acceptance gate: fixed-seed schedules over {rank_death, rank_join,
+    compute.straggle} end oracle-exact (PASS — recovered, regrown, or
+    hedged) or classified — zero violations, zero watchdog deaths, all
+    three membership sites exercised, and the manifest audit sums exactly
+    to the oracle on every PASS (zero double-counted partitions)."""
     runner = chaos.RecoveryChaosRunner(num_nodes=4, size=1 << 11)
-    outcomes, summary = chaos.soak_recovery(4, base_seed=100, runner=runner)
+    outcomes, summary = chaos.soak_recovery(6, base_seed=230, runner=runner)
     assert summary["violations"] == 0, [
         o.to_json() for o in outcomes if o.status == chaos.VIOLATION]
     assert summary["wdogtrip"] == 0
     assert summary["ranklost"] >= 1
+    assert summary["rankjoin"] >= 1
+    assert summary["hedged"] >= 1
+    assert summary["hedgewin"] >= 1
     assert summary["recovered_partitions"] >= 1
     assert summary["max_epoch"] >= 1
+    assert summary["manifest_exact"] >= summary["pass"]
 
 
 def test_generate_recovery_schedule_always_arms_rank_death():
-    for seed in range(20):
+    sites_seen = set()
+    for seed in range(40):
         sched = chaos.generate_recovery_schedule(seed)
         sites = [site for site, _ in sched.arms]
         assert sites[0] == faults.RANK_DEATH
         assert all(s in chaos.RECOVERY_SITES for s in sites)
+        sites_seen.update(sites)
+    # the growth/straggle interleavings (join-during-recovery,
+    # straggle-then-die) are part of the generated vocabulary
+    assert faults.RANK_JOIN in sites_seen
+    assert faults.COMPUTE_STRAGGLE in sites_seen
     assert chaos.generate_recovery_schedule(3) == \
         chaos.generate_recovery_schedule(3)
 
@@ -482,3 +836,81 @@ def test_two_process_sigkill_recovery(tmp_path):
     assert "[RESULTS] Expected: 8192 (OK)" in outs[0], joined
     assert "RANKLOST\t1" in outs[0], joined
     assert "MEPOCH\t1" in outs[0], joined
+
+
+# ---------------------------------------------- 2->3 process elastic growth
+def test_two_to_three_process_elastic_join(tmp_path):
+    """THE growth scenario, with real processes: a newcomer boots FIRST
+    (its ``joining`` lease predates the incumbents' first boundary scan),
+    two jax.distributed incumbents admit it with one fenced epoch bump
+    and re-expand the plan over the grown membership, the newcomer
+    executes its share through the shared manifest, and all THREE exit 0
+    oracle-exact — the admission mirror of the SIGKILL test above."""
+    import socket
+    import time
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    lease_dir = str(tmp_path / "leases")
+    ckpt_dir = str(tmp_path / "ckpt")
+    base = ["--tuples-per-node", "1024", "--nodes", "8",
+            "--network-fanout", "3", "--elastic", "on",
+            "--rank-lease-s", "5.0", "--lease-dir", lease_dir,
+            "--checkpoint-dir", ckpt_dir]
+
+    def spawn(argv_extra, env_extra):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS",
+                            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                            "JAX_PROCESS_ID")}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            PYTHONPATH=repo + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        env.update(env_extra)
+        return subprocess.Popen(
+            [sys.executable, "-m", "tpu_radix_join.main"] + base
+            + argv_extra,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True, cwd=repo)
+
+    # the newcomer first: a plain single process, no coordinator — its
+    # joining lease must be on disk before the incumbents' first scan
+    joiner = spawn(["--elastic-join", "2"], {})
+    deadline = time.monotonic() + 60.0
+    lease_path = os.path.join(lease_dir, "lease_r2.json")
+    while time.monotonic() < deadline and not os.path.exists(lease_path):
+        assert joiner.poll() is None, joiner.communicate()[0]
+        time.sleep(0.1)
+    assert os.path.exists(lease_path), "joining lease never appeared"
+
+    incumbents = [
+        spawn(["--hosts", "2", "--elastic-grow"],
+              {"JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+               "JAX_NUM_PROCESSES": "2", "JAX_PROCESS_ID": str(rank)})
+        for rank in range(2)]
+    procs = incumbents + [joiner]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    joined = "\n---- rank boundary ----\n".join(outs)
+    assert [p.returncode for p in procs] == [0, 0, 0], joined
+    # the incumbents admitted, fenced once, and re-expanded
+    assert "[RESULTS] regrown:" in outs[0], joined
+    assert "[RESULTS] Expected: 8192 (OK)" in outs[0], joined
+    assert "[RESULTS] RANKJOIN: max 1" in outs[0], joined
+    assert "[RESULTS] MEPOCH: max 1" in outs[0], joined
+    # the newcomer was admitted, did real work, and saw the manifest
+    # reach completeness — oracle-exact from its own seat
+    assert "[RESULTS] joiner: rank=2 epoch=1" in outs[2], joined
+    assert "(OK)" in outs[2], joined

@@ -98,6 +98,35 @@ pinned lower-is-better: a fleet that starts losing more ranks or
 fencing more epochs per round regresses even when each individual
 recovery still lands oracle-exact.
 
+The ``--recovery-bench --straggle f`` arm gates the straggler-hedging
+tail A/B (robustness/straggler.py — speculative recompute of a slow
+rank's unfinished partitions through the manifest fence):
+
+    {"metric": "straggler_hedge_tail_speedup", "value": 2.62,
+     "size": 131072, "num_partitions": 32, "straggle_factor": 4.0,
+     "hedged_ms": 526.3, "unhedged_ms": 1379.9, "hedgewin": 4,
+     "specwaste": 0, "recovern": 4, "manifest_total": 131072}
+
+The headline ``value`` is the tail ratio (unhedged wall over hedged
+wall, higher is better).  ``hedged_ms``/``unhedged_ms`` are walls and
+``specwaste`` counts speculative recomputes the original won anyway —
+all lower-is-better — while ``hedgewin`` (fence wins per hedge round)
+is pinned higher-is-better: fewer wins at the same hedge count means
+the detector started hedging partitions that were about to finish.
+
+The ``--recovery-bench --grow`` arm gates mid-run admission vs fixed
+survivors (rank admission re-expanding the assignment map):
+
+    {"metric": "elastic_grow_speedup", "value": 1.18, "size": 524288,
+     "num_partitions": 32, "grown_ms": 20.5, "fixed_ms": 24.2,
+     "recovern": 18, "resumed_partitions": 14, "rankjoin": 1,
+     "survivors_fixed": 8, "survivors_grown": 9}
+
+``grown_ms``/``fixed_ms`` are the critical-path recompute walls (the
+slowest single survivor's share — what decides when a data-parallel
+epoch completes) and gate lower-is-better; ``rankjoin`` is declared
+neutral (a grow arm admits by design — losses regress, joins don't).
+
 The static-analysis counters gate the same way: ``lint_findings`` and
 ``stale_baseline`` (``tools_lint.py --json`` — live graftlint findings
 and baseline suppressions whose finding was already fixed) are pinned

@@ -82,19 +82,46 @@ def main(argv=None) -> int:
             print(f"by rank:          {summary['by_rank']}")
             print(f"by mesh epoch:    {summary['by_membership_epoch']}")
             if summary["recovery_timeline"]:
+                # grouped by membership epoch: every epoch's block reads
+                # as one fencing story — what changed the membership
+                # (loss/admission), the hedge fence claims written under
+                # it, and the recovery that closed it
                 print("recovery timeline:")
+                by_epoch = {}
                 for ev in summary["recovery_timeline"]:
-                    what = ev.get("event")
-                    if what == "rank_lost":
-                        detail = (f"lost={ev.get('ranks')} "
-                                  f"cause={ev.get('cause')} "
-                                  f"survivors={ev.get('survivors')}")
-                    else:
-                        detail = (f"resumed={ev.get('resumed')} "
-                                  f"recomputed={ev.get('recomputed')} "
-                                  f"matches={ev.get('matches')}")
-                    print(f"  t={ev.get('t_epoch_s')} rank={ev.get('rank')} "
-                          f"{what} epoch={ev.get('epoch')} {detail}")
+                    by_epoch.setdefault(ev.get("epoch"), []).append(ev)
+                for epoch in sorted(by_epoch,
+                                    key=lambda e: (e is None, e)):
+                    print(f"  membership epoch {epoch}:")
+                    for ev in by_epoch[epoch]:
+                        what = ev.get("event")
+                        if what == "rank_lost":
+                            detail = (f"lost={ev.get('ranks')} "
+                                      f"cause={ev.get('cause')} "
+                                      f"survivors={ev.get('survivors')}")
+                        elif what == "rank_join":
+                            detail = (f"admitted={ev.get('ranks')} "
+                                      f"members={ev.get('members')}")
+                        elif what == "hedge_claim":
+                            detail = (f"partition={ev.get('partition')} "
+                                      f"owner={ev.get('owner')}")
+                        elif what == "hedge":
+                            detail = (f"straggler={ev.get('straggler')} "
+                                      f"progress={ev.get('progress')} "
+                                      f"median={ev.get('median')} "
+                                      f"outstanding="
+                                      f"{ev.get('outstanding')}")
+                        elif what == "straggle":
+                            detail = (f"victim={ev.get('rank')} "
+                                      f"factor={ev.get('factor')}")
+                        elif what == "regrow":
+                            detail = f"joined={ev.get('joined_ranks')}"
+                        else:
+                            detail = (f"resumed={ev.get('resumed')} "
+                                      f"recomputed={ev.get('recomputed')} "
+                                      f"matches={ev.get('matches')}")
+                        print(f"    t={ev.get('t_epoch_s')} "
+                              f"rank={ev.get('rank')} {what} {detail}")
             for row in summary["rows"]:
                 if "error" in row:
                     print(f"  UNREADABLE {row['path']}: {row['error']}")

@@ -285,6 +285,53 @@ def build_parser() -> argparse.ArgumentParser:
                         "for real (SIGKILL, the multi-rank recovery test's "
                         "victim); otherwise the highest node rank's death "
                         "is simulated and --elastic on recovers it")
+    p.add_argument("--rank-missed-beats", type=int, default=2, metavar="N",
+                   help="lapse threshold in missed heartbeats: a lease is "
+                        "declared lost only after N full lease windows of "
+                        "silence (lapse window = N x --rank-lease-s; "
+                        "default 2 — one missed beat never kills a rank)")
+    p.add_argument("--elastic-grow", action="store_true",
+                   help="admit joining ranks mid-run (rank admission, "
+                        "robustness/membership.py): a newcomer's 'joining' "
+                        "lease is admitted at the next phase boundary with "
+                        "a fenced epoch bump, and the next epoch's recovery "
+                        "plan re-expands partition assignment onto it; "
+                        "requires --elastic on")
+    p.add_argument("--elastic-join", type=int, default=None, metavar="N",
+                   help="run as a JOINING process against an N-rank "
+                        "incumbent world (the growth half's newcomer): "
+                        "write a joining lease under the shared "
+                        "--lease-dir, wait for admission (an incumbent "
+                        "epoch bump), then recompute this rank's share of "
+                        "unfinished partitions through the shared "
+                        "--checkpoint-dir manifest; mutually exclusive "
+                        "with driving a join")
+    p.add_argument("--hedge", choices=["on", "off", "auto"], default="off",
+                   help="straggler hedging (robustness/straggler.py): when "
+                        "a live rank's manifest progress falls below "
+                        "--hedge-threshold x the median for two "
+                        "consecutive boundary checks, speculatively "
+                        "recompute its unfinished partitions; the manifest "
+                        "fence (first writer wins) keeps speculation from "
+                        "double-counting; 'auto' backs off while "
+                        "SPECWASTE > HEDGEWIN")
+    p.add_argument("--hedge-threshold", type=float, default=0.5,
+                   metavar="F",
+                   help="relative-progress straggler threshold: hedge when "
+                        "slowest < F x median partitions done (default "
+                        "0.5; must be in (0, 1))")
+    p.add_argument("--straggle-factor", type=float, default=0.0,
+                   metavar="F",
+                   help="arm the compute.straggle chaos site: the highest "
+                        "node rank stalls for F x TPU_RJ_STRAGGLE_UNIT_S "
+                        "at the first phase boundary — the hedging "
+                        "benchmark's slow-rank model (0 = off)")
+    p.add_argument("--rank-join-at", type=int, default=None, metavar="N",
+                   help="arm the membership.rank_join chaos site at the "
+                        "N-th phase boundary (1-based): a synthetic "
+                        "joining lease appears beyond the boot world and "
+                        "--elastic-grow admits it — the single-process "
+                        "growth test's newcomer")
     p.add_argument("--pipeline-repeats", action="store_true",
                    help="dispatch the --repeat joins asynchronously and "
                         "fence once (amortized-throughput methodology, "
@@ -513,7 +560,10 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
                           plan_cache=plan_cache, profile=args.profile,
                           forensics_dir=_forensics_dir(args),
                           ledger=ledger, membership=membership,
-                          elastic=args.elastic == "on")
+                          elastic=args.elastic == "on",
+                          elastic_grow=args.elastic_grow,
+                          hedge=args.hedge,
+                          hedge_threshold=args.hedge_threshold)
     if sampler is not None:
         # heartbeat ticks carry the live SLO/breaker snapshot in serve mode;
         # with membership attached the lease write rides the same tick
@@ -578,6 +628,141 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         session.close()
 
 
+def _run_joiner(args, cfg, meas, nodes, *, membership) -> int:
+    """The newcomer's half of elastic growth (``--elastic-join N``).
+
+    Mirror image of the incumbents' admission path
+    (membership.MembershipView._admit): this process wrote a ``joining``
+    lease before any work; here it (1) waits for an incumbent epoch bump
+    — the fenced admission signal, readable from the shared lease dir
+    with no coordinator — then (2) regenerates the deterministic
+    relations host-side and recomputes ITS share of unfinished
+    partitions through the shared manifest, exactly the
+    ``execute_recovery(only_rank=...)`` multi-survivor discipline the
+    incumbents' regrow uses.  Divergent plan timing across processes is
+    safe: the manifest fence (first writer wins within an epoch) makes
+    double-computation waste, never double-counting.
+    """
+    import os
+    import time as _time
+
+    from tpu_radix_join import Relation
+    from tpu_radix_join.robustness.checkpoint import PartitionManifest
+    from tpu_radix_join.robustness.recovery import (execute_recovery,
+                                                    host_keys,
+                                                    partition_weights,
+                                                    plan_recovery)
+
+    board = membership.board
+    num_ranks = board.num_ranks            # incumbent world size (= N)
+    if nodes % num_ranks:
+        print(f"[RESULTS] failure/joiner: {nodes} nodes do not divide "
+              f"over {num_ranks} incumbent ranks", file=sys.stderr)
+        return 1
+    npp = nodes // num_ranks
+    my_nodes = list(range(board.rank * npp, (board.rank + 1) * npp))
+    print(f"[ELASTIC] joiner rank={board.rank} nodes={my_nodes} "
+          f"waiting for admission under {board.run_dir}", file=sys.stderr)
+
+    # -- wait for the fenced admission: any incumbent member lease at
+    # epoch >= 1 means the board admitted someone (us — we are the only
+    # joining lease we wrote) and the next plan prices us in
+    deadline = _time.monotonic() + max(120.0, 6.0 * board.lapse_window_s)
+    admitted_epoch = 0
+    while _time.monotonic() < deadline:
+        for r in board.discover():
+            if r == board.rank:
+                continue
+            lease = board.read(r)
+            if (lease is not None and lease.status == "member"
+                    and lease.epoch > admitted_epoch):
+                admitted_epoch = lease.epoch
+        if admitted_epoch >= 1:
+            break
+        board.heartbeat(membership.epoch, status="joining")
+        _time.sleep(min(0.2, board.lease_s / 4.0))
+    if admitted_epoch < 1:
+        print("[RESULTS] failure/joiner: no admission epoch bump before "
+              "deadline — incumbents never saw the joining lease "
+              "(dead world, or --elastic-grow not set there)",
+              file=sys.stderr)
+        return 1
+    membership.epoch = admitted_epoch
+    membership.joined.add(board.rank)
+    board.heartbeat(admitted_epoch, status="member")
+    print(f"[ELASTIC] joiner admitted epoch={admitted_epoch}",
+          file=sys.stderr)
+
+    # -- regenerate the deterministic inputs host-side (the property
+    # that makes coordinator-free growth possible: a newcomer computes
+    # the same host_keys every incumbent does)
+    global_size = args.tuples_per_node * nodes
+    inner = Relation(global_size, nodes, "unique", seed=args.seed)
+    outer_kw = {}
+    if args.outer_kind == "modulo":
+        outer_kw["modulo"] = args.modulo or max(1, global_size // 4)
+    elif args.outer_kind == "zipf":
+        outer_kw["zipf_theta"] = args.zipf_theta
+        outer_kw["key_domain"] = global_size
+    outer = Relation(global_size, nodes, args.outer_kind,
+                     seed=args.seed + 1, **outer_kw)
+    rk, rhi = host_keys(inner)
+    sk, shi = host_keys(outer)
+    num_p = cfg.network_partition_count
+    fp = (f"elastic:{args.outer_kind}:{global_size}:"
+          f"{args.seed}:{num_p}")
+    os.makedirs(args.checkpoint_dir, exist_ok=True)
+    manifest = PartitionManifest(
+        os.path.join(args.checkpoint_dir, "partitions.manifest"),
+        fingerprint=fp, measurements=meas)
+
+    plan = plan_recovery(
+        num_nodes=nodes, num_partitions=num_p, lost_ranks=[],
+        epoch=admitted_epoch, manifest=manifest,
+        weights=partition_weights(rk, sk, num_p),
+        joined_ranks=my_nodes)
+    board.heartbeat(admitted_epoch, status="member")
+    matches, counts = execute_recovery(
+        plan, rk, sk, rhi, shi, only_rank=set(my_nodes),
+        manifest=manifest, measurements=meas)
+
+    # -- report once the shared manifest is complete: our share is done
+    # (post-realization lines above), the rest arrives as incumbents
+    # finish theirs — completeness, not a barrier, is the exit signal
+    deadline = _time.monotonic() + max(120.0, 6.0 * board.lapse_window_s)
+    while _time.monotonic() < deadline:
+        if len(manifest.completed()) >= num_p:
+            break
+        board.heartbeat(admitted_epoch, status="member")
+        _time.sleep(0.1)
+    done = manifest.completed()
+    matches = int(sum(rec["count"] for rec in done.values()))
+    mine = sum(1 for rec in done.values()
+               if rec.get("owner") in set(my_nodes))
+    expected = inner.expected_matches(outer)
+    print(f"[RESULTS] joiner: rank={board.rank} epoch={admitted_epoch} "
+          f"owned_partitions={mine} "
+          f"manifest_partitions={len(done)}/{num_p}")
+    print(f"[RESULTS] Tuples: {matches}")
+    if expected is not None:
+        status = "OK" if matches == expected else "MISMATCH"
+        print(f"[RESULTS] Expected: {expected} ({status})")
+        if matches != expected:
+            return 1
+    if len(done) < num_p:
+        print("[RESULTS] failure/joiner: manifest incomplete at "
+              "deadline", file=sys.stderr)
+        return 1
+    aud = manifest.audit()
+    print(f"[ELASTIC] joiner manifest audit total={aud['total']} "
+          f"fenced_duplicates={aud['fenced_duplicates']}",
+          file=sys.stderr)
+    if args.output_dir:
+        path = meas.store(args.output_dir)
+        print(f"[PERF] stored {path}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = build_parser()
     args = parser.parse_args(argv)
@@ -590,6 +775,30 @@ def main(argv=None) -> int:
     if args.serve is not None and args.grid_chunk_tuples is not None:
         parser.error("--serve runs the in-core resident engine; the "
                      "out-of-core grid is a one-shot mode")
+    if args.elastic_grow and args.elastic != "on":
+        parser.error("--elastic-grow admits ranks into the elastic "
+                     "recovery protocol — it needs --elastic on")
+    if args.hedge != "off" and args.elastic != "on":
+        parser.error("--hedge speculates through the elastic recovery "
+                     "machinery — it needs --elastic on")
+    if not 0.0 < args.hedge_threshold < 1.0:
+        parser.error("--hedge-threshold must be in (0, 1): it is the "
+                     "slowest/median progress ratio below which hedging "
+                     "arms")
+    if args.rank_missed_beats < 1:
+        parser.error("--rank-missed-beats must be >= 1")
+    if args.elastic_join is not None:
+        if not args.checkpoint_dir:
+            parser.error("--elastic-join recomputes through the shared "
+                         "partition manifest — pass the incumbents' "
+                         "--checkpoint-dir")
+        if args.elastic != "on":
+            parser.error("--elastic-join is the growth half of elastic "
+                         "recovery — it needs --elastic on")
+        if not args.nodes:
+            parser.error("--elastic-join cannot infer the incumbent "
+                         "world's node count from its own devices — "
+                         "pass the incumbents' --nodes")
 
     import contextlib
     import os
@@ -679,17 +888,42 @@ def main(argv=None) -> int:
     if args.elastic == "on" or distributed:
         from tpu_radix_join.robustness.membership import (LeaseBoard,
                                                           MembershipView)
-        board = LeaseBoard(_lease_dir(args), rank=jax.process_index(),
-                           num_ranks=jax.process_count(),
-                           lease_s=args.rank_lease_s, measurements=meas)
-        membership = MembershipView(board, measurements=meas)
-        board.heartbeat(0)           # first lease before any join work
+        if args.elastic_join is not None:
+            # joiner mode: rank comes from the shared lease dir (first
+            # free id at or above the incumbent world size), and the
+            # first lease is a JOINING lease — admission is the
+            # incumbents' move, not ours
+            lease_dir = _lease_dir(args)
+            rank = LeaseBoard.next_rank(lease_dir,
+                                        floor=args.elastic_join)
+            board = LeaseBoard(lease_dir, rank=rank,
+                               num_ranks=args.elastic_join,
+                               lease_s=args.rank_lease_s,
+                               missed_beats=args.rank_missed_beats,
+                               measurements=meas)
+            membership = MembershipView(board, measurements=meas)
+            board.heartbeat(0, status="joining")
+        else:
+            board = LeaseBoard(_lease_dir(args), rank=jax.process_index(),
+                               num_ranks=jax.process_count(),
+                               lease_s=args.rank_lease_s,
+                               missed_beats=args.rank_missed_beats,
+                               measurements=meas)
+            membership = MembershipView(board, measurements=meas)
+            board.heartbeat(0)       # first lease before any join work
         if sampler is not None:
             # liveness rides the telemetry cadence: every sampler tick
-            # heartbeats the lease and reports the membership epoch
-            sampler.extra = board.sampler_extra(epoch_of=membership.epoch_of)
+            # heartbeats the lease and reports the membership epoch +
+            # lease status (a joiner's tick says "joining" until its
+            # own view admits it)
+            sampler.extra = board.sampler_extra(
+                epoch_of=membership.epoch_of,
+                status_of=membership.my_status)
     try:
-        if args.serve is not None:
+        if args.elastic_join is not None:
+            rc = _run_joiner(args, cfg, meas, nodes,
+                             membership=membership)
+        elif args.serve is not None:
             rc = _run_serve(args, cfg, meas, nodes, sampler=sampler,
                             membership=membership)
         else:
@@ -889,6 +1123,10 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
         engine.membership = membership
         engine.elastic = elastic
         engine.partition_manifest = manifest
+        engine.elastic_grow = args.elastic_grow
+        engine.hedge = args.hedge
+        engine.hedge_threshold = args.hedge_threshold
+        engine.straggle_factor = args.straggle_factor
 
     global_size = args.tuples_per_node * nodes
     meas.meta.update(tuples_per_node=args.tuples_per_node,
@@ -937,12 +1175,22 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
         # Relation specs, never the distributed arrays (hash_join.join()
         # records the same pair on the Relations API path)
         engine._elastic_rel = (inner, outer)
-    # --rank-death-at: arm the chaos site on THIS process; the victim of
-    # the multi-rank recovery test additionally sets the suicide env var
+    # Membership chaos sites arm on ONE injector: only the innermost
+    # installed injector is consulted (faults.py stacking), so a driver
+    # mixing --rank-death-at / --rank-join-at / --straggle-factor must
+    # register every site on the same instance.  The victim of the
+    # multi-rank recovery test additionally sets the suicide env var.
     from tpu_radix_join.robustness import faults as _faults
-    death_ctx = (_faults.FaultInjector(seed=args.seed, measurements=meas)
-                 .arm(_faults.RANK_DEATH, at=args.rank_death_at)
-                 if args.rank_death_at else contextlib.nullcontext())
+    death_ctx = contextlib.nullcontext()
+    if args.rank_death_at or args.rank_join_at or args.straggle_factor > 0:
+        inj = _faults.FaultInjector(seed=args.seed, measurements=meas)
+        if args.rank_death_at:
+            inj.arm(_faults.RANK_DEATH, at=args.rank_death_at)
+        if args.rank_join_at:
+            inj.arm(_faults.RANK_JOIN, at=args.rank_join_at)
+        if args.straggle_factor > 0:
+            inj.arm(_faults.COMPUTE_STRAGGLE, at=1)
+        death_ctx = inj
     # --transfer-guard: the runtime half of the sync-point discipline —
     # the static rule (tools_lint.py) forbids implicit readback spellings;
     # this guard proves at run time that none slipped through a dynamic
@@ -1017,6 +1265,15 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
               f"lost_ranks={d.get('lost_ranks')} "
               f"resumed={len(d.get('resumed_partitions') or [])} "
               f"recomputed={len(d.get('recovered_partitions') or [])}")
+        if d.get("regrown"):
+            print(f"[RESULTS] regrown: "
+                  f"joined_ranks={d.get('joined_ranks_admitted')} "
+                  f"survivors={d.get('survivors')}")
+        if d.get("hedged"):
+            print(f"[RESULTS] hedged: straggler={d.get('straggler')} "
+                  f"partitions={d.get('hedged_partitions')} "
+                  f"hedgewin={d.get('hedgewin')} "
+                  f"specwaste={d.get('specwaste')}")
     # The reference's rank-0 aggregate report (Measurements.cpp:592-702):
     # multi-process worlds gather every rank's registry over the network
     # first (Measurements.gather_all); rank 0 alone prints.  After a rank

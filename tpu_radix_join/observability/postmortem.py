@@ -316,10 +316,15 @@ def merge_bundles(paths) -> dict:
         counters = b.get("counters") or {}
         mepoch = extra.get("membership_epoch", counters.get("MEPOCH"))
         epochs[str(mepoch)] = epochs.get(str(mepoch), 0) + 1
-        # the recovery timeline: rank_lost / recovery events from every
-        # bundle's event tail, aligned on the cross-process wall clock
+        # the recovery timeline: membership + recovery events from every
+        # bundle's event tail, aligned on the cross-process wall clock —
+        # losses and recoveries, plus the growth/hedging vocabulary
+        # (admissions, hedge fence claims, regrow/hedge recoveries,
+        # straggle verdicts)
         for ev in b.get("events_tail") or []:
-            if ev.get("event") in ("rank_lost", "recovery"):
+            if ev.get("event") in ("rank_lost", "recovery", "rank_join",
+                                   "hedge_claim", "regrow", "hedge",
+                                   "straggle"):
                 timeline.append(dict(ev, rank=rank, bundle=p))
         rows.append({"path": p, "reason": b.get("reason"),
                      "failure_class": fc, "rank": rank,

@@ -31,7 +31,15 @@ DEFAULT_THRESHOLD = 0.25       # bench timings through a shared tunnel are
 _HIGHER_BETTER = {"value", "vs_baseline",
                   # warm queries are capacity-cache hits: fewer means the
                   # resident session stopped amortizing its sizing passes
-                  "QWARM"}
+                  "QWARM",
+                  # of the hedges a round launches, the ones whose claim
+                  # wins the manifest fence are the ones that bought tail
+                  # latency: fewer wins at the same HEDGED count means the
+                  # hedges stopped landing before the originals
+                  "HEDGEWIN",
+                  # lowercase twin for the --recovery-bench --straggle
+                  # artifact key (fence wins per hedge round)
+                  "hedgewin"}
 _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
                              "tuples_per_sec", "per_sec", "pairs/sec",
                              "speedup",
@@ -103,6 +111,17 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # healthy fleet holds MEPOCH at 0
                             "ranklost", "recover_ms", "recoverms",
                             "recovern", "mepoch", "restart_ms",
+                            # straggler hedging (--recovery-bench --straggle
+                            # and the SPECWASTE counter): both tail walls are
+                            # times (the headline tail speedup rides the
+                            # "speedup" substring above), and more wasted
+                            # speculative recomputes per round means the
+                            # detector is hedging partitions the original
+                            # was about to finish anyway
+                            "specwaste", "hedged_ms", "unhedged_ms",
+                            # mesh growth (--recovery-bench --grow): both
+                            # arms' recompute walls are times
+                            "grown_ms", "fixed_ms",
                             # static-analysis gate (tools_lint.py --json):
                             # more live lint findings is strictly worse —
                             # a finding-count regression gates like a perf
@@ -128,7 +147,13 @@ _COST_TAGS = {"JTOTAL", "JPROC", "JHIST", "JMPI", "JCOMPILE", "SWINALLOC",
               "VFAIL", "VREPAIR",
               "PARTPASS", "SORTPASS",
               "MWINBYTES", "PACKRATIO",
-              "JXAUDIT"}
+              "JXAUDIT",
+              # straggler hedging: more hedges per round means more ranks
+              # fell below the relative-progress threshold (the detector
+              # may be right every time and it is still a fleet-health
+              # regression); SPECWASTE also rides the lower-is-better
+              # substring for the bench artifact keys
+              "HEDGED", "SPECWASTE"}
 # Explicitly neutral tags: workload/geometry descriptors with no
 # regression direction (tuple counts scale with the input, capacities
 # and stage counts describe the plan, chaos/checkpoint counters describe
@@ -140,10 +165,18 @@ NEUTRAL_TAGS = {"RTUPLES", "STUPLES", "RESULTS",
                 "BPBUILDTUPLES", "BPPROBETUPLES",
                 "VCHKN", "QADMIT", "BRKPROBE",
                 "FINJECT", "CKPTSAVE", "CKPTLOAD", "GRIDPAIRS",
-                "STATICMEM"}
+                "STATICMEM",
+                # admissions describe the scenario (a grow arm admits by
+                # design); losses regress, joins don't
+                "RANKJOIN", "rankjoin"}
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
-         "schema_version"}
+         "schema_version",
+         # --recovery-bench --grow/--straggle scenario descriptors: the
+         # injected slowdown, the membership split, and the audit total
+         # parameterize the arm, they do not measure it
+         "straggle_factor", "survivors_fixed", "survivors_grown",
+         "manifest_total"}
 
 
 def higher_is_better(tag: str) -> bool:

@@ -76,13 +76,19 @@ from tpu_radix_join.parallel.network_partitioning import (network_partition,
                                                           receive_checksums)
 from tpu_radix_join.parallel.window import (ExchangeResult, Window,
                                             parse_exchange_mode)
-from tpu_radix_join.performance.measurements import (BACKOFFMS, MEPOCH,
+from tpu_radix_join.performance.measurements import (BACKOFFMS, HEDGED,
+                                                     HEDGEWIN, MEPOCH,
                                                      PACKRATIO, RANKLOST,
-                                                     RETRYN, VCHK, VCHKN,
-                                                     VFAIL, VREPAIR, XSTAGES)
+                                                     RETRYN, SPECWASTE, VCHK,
+                                                     VCHKN, VFAIL, VREPAIR,
+                                                     XSTAGES)
 from tpu_radix_join.robustness import faults as _faults
 from tpu_radix_join.robustness import verify as _verify
-from tpu_radix_join.robustness.membership import RankLost, StaleEpoch
+from tpu_radix_join.robustness.membership import (LeaseBoard, RankJoined,
+                                                  RankLost, StaleEpoch)
+from tpu_radix_join.robustness.straggler import (StragglerDetected,
+                                                 StragglerDetector,
+                                                 board_progress, score_hedge)
 from tpu_radix_join.utils.hostsync import host_readback
 from tpu_radix_join.robustness.retry import (CAPACITY_OVERFLOW,
                                              RETRIES_EXHAUSTED,
@@ -218,6 +224,22 @@ class HashJoin:
         self.membership = None
         self.elastic = False
         self.partition_manifest = None
+        # growth + hedging knobs (same attribute-style wiring):
+        # ``elastic_grow`` makes a mid-join admission (RankJoined) finish
+        # the join on the GROWN membership instead of raising;
+        # ``hedge`` ("off"|"on"|"auto") enables straggler hedging —
+        # "auto" additionally backs off while wasted speculation
+        # (SPECWASTE) outruns manifest-fence wins (HEDGEWIN);
+        # ``straggle_factor`` scales the compute.straggle site's
+        # simulated per-rank slowdown (chaos runner / bench set it from
+        # their seeds)
+        self.elastic_grow = False
+        self.hedge = "off"
+        self.hedge_threshold = 0.5
+        self.straggle_factor = 0.0
+        self.straggle_unit_s = float(
+            os.environ.get("TPU_RJ_STRAGGLE_UNIT_S", "0.05"))
+        self._straggler_detector = None
         # Relation pair of the in-flight join(): recovery regenerates
         # global key lanes host-side from these deterministic specs — it
         # must never read a distributed array once a peer is dead (any
@@ -1718,11 +1740,20 @@ class HashJoin:
         set_default_sort_impl(self.config.sort_impl)
         if not self.elastic and self.partition_manifest is None:
             return self._join_arrays_inner(r, s, repeats)
+        if (self.membership is not None and self.partition_manifest is not None
+                and self.membership.board.progress_of is None):
+            # export this process's manifest progress on every lease beat
+            # — the per-rank progress clock the straggler detector reads
+            self.membership.board.progress_of = self._my_partitions_done
         try:
             result = self._join_arrays_inner(r, s, repeats)
         except BaseException as e:     # noqa: BLE001 — triaged below
             if not self.elastic:
                 raise
+            if isinstance(e, StragglerDetected):
+                return self._hedge_join(r, s, e, repeats)
+            if isinstance(e, RankJoined):
+                return self._regrow_join(r, s, e, repeats)
             exc = self._as_rank_lost(e)
             if exc is None:
                 raise
@@ -1825,6 +1856,13 @@ class HashJoin:
                         m.stop("JTOTAL")
                     raise _faults.TransientFault(_faults.BACKEND_STALL, 1)
                 time.sleep(0.01)
+        if _faults.fires(_faults.COMPUTE_STRAGGLE, m):
+            # simulated alive-but-slow rank: unlike BACKEND_STALL this is
+            # NOT an infrastructure failure — the straggler keeps
+            # heartbeating, so the lease machinery must never declare it
+            # dead; with hedging enabled the detector turns the stretch
+            # into a bounded speculative recompute instead
+            self._compute_straggle()
         # integrity verification (robustness/verify.py): fingerprint the
         # pristine inputs before anything can damage them.  The n==1 sort
         # specialization performs no exchange (nothing to verify against)
@@ -1930,23 +1968,42 @@ class HashJoin:
 
     def _check_cancel(self, phase: str) -> None:
         """Phase-boundary service point: consult the injectable
-        ``membership.rank_death`` site, the membership view (lease scan),
-        and the cooperative cancellation hook, in that order.  On any
-        raise the open JTOTAL timer is closed first so the aborted query
-        still reports how long it ran before it died."""
+        ``membership.rank_death`` / ``membership.rank_join`` sites, the
+        membership view (lease scan: admissions then lapses), the
+        straggler detector (when hedging), and the cooperative
+        cancellation hook, in that order.  On any raise the open JTOTAL
+        timer is closed first so the aborted query still reports how
+        long it ran before it died."""
         m = self.measurements
         try:
             if _faults.fires(_faults.RANK_DEATH, m):
                 self._rank_death(phase)
+            if _faults.fires(_faults.RANK_JOIN, m):
+                self._rank_join(phase)
             if self.membership is not None:
+                mv = self.membership
                 # self-heartbeat rides the same boundary as the peer scan:
                 # a long compile/dispatch gap must not lapse OUR lease just
                 # because no sampler thread is ticking it
-                self.membership.board.heartbeat(self.membership.epoch)
-                newly = self.membership.check()
+                mv.board.heartbeat(mv.epoch, status=mv.my_status())
+                prev_joined = set(mv.joined)
+                newly = mv.check()
                 if newly:
-                    raise RankLost(newly[0], self.membership.epoch,
+                    raise RankLost(newly[0], mv.epoch,
                                    f"lease lapsed at phase {phase!r}")
+                admitted = sorted(mv.joined - prev_joined)
+                if admitted and self.elastic_grow:
+                    # publish the fenced epoch on our lease BEFORE the
+                    # re-expansion: the newcomer's admission signal is an
+                    # incumbent member lease at the bumped epoch, and the
+                    # run may end before another boundary heartbeats it
+                    mv.board.heartbeat(mv.epoch, status=mv.my_status())
+                    # in-flight work is stamped with the pre-admission
+                    # epoch; finish on the grown membership instead of
+                    # dispatching stale-epoch collectives
+                    raise RankJoined(admitted, mv.epoch)
+                if self._should_hedge():
+                    self._poll_straggler(phase)
             if self.cancel is not None:
                 self.cancel(phase)
         except BaseException:
@@ -1982,6 +2039,123 @@ class HashJoin:
                         survivors=self.config.num_nodes - 1)
         raise RankLost(victim, epoch, f"injected at phase {phase!r}")
 
+    def _rank_join(self, phase: str) -> None:
+        """The ``membership.rank_join`` chaos site fired at this phase
+        boundary: simulate a newcomer by writing a fresh ``joining``
+        lease for the next unused rank — the stand-in for a real new
+        process's first heartbeat.  The ordinary admission scan in
+        :meth:`_check_cancel`'s ``membership.check()`` does the rest
+        (fenced epoch bump, RANKJOIN, and — under ``elastic_grow`` —
+        the :class:`RankJoined` re-expansion)."""
+        mv = self.membership
+        if mv is None:
+            return
+        board = mv.board
+        new_rank = LeaseBoard.next_rank(board.run_dir,
+                                        floor=board.num_ranks)
+        joiner = LeaseBoard(board.run_dir, new_rank, board.num_ranks,
+                            lease_s=board.lease_s, clock=board.clock,
+                            missed_beats=board.missed_beats)
+        joiner.heartbeat(mv.epoch, status="joining")
+        m = self.measurements
+        if m is not None:
+            m.event("rank_join_injected", rank=new_rank, phase=phase)
+
+    # ------------------------------------------------------------- hedging
+    def _should_hedge(self) -> bool:
+        """Hedging needs the manifest fence (no fence, no safe
+        speculation) and a membership view; ``auto`` additionally backs
+        off while wasted speculation outruns wins — the SPECWASTE /
+        HEDGEWIN closed loop."""
+        if self.hedge == "off" or self.membership is None \
+                or self.partition_manifest is None:
+            return False
+        if self.hedge == "auto":
+            m = self.measurements
+            if m is not None and (m.counters.get(SPECWASTE, 0)
+                                  > m.counters.get(HEDGEWIN, 0)):
+                return False
+        return True
+
+    def _detector(self) -> StragglerDetector:
+        if self._straggler_detector is None:
+            self._straggler_detector = StragglerDetector(
+                threshold=self.hedge_threshold)
+        return self._straggler_detector
+
+    def _my_partitions_done(self) -> int:
+        """This process's manifest progress (partitions realized by node
+        ranks it owns) — exported on every lease beat as the per-rank
+        progress clock."""
+        mf = self.partition_manifest
+        if mf is None:
+            return -1
+        done = mf.completed()
+        scope = self._recovery_scope()
+        if scope is None:
+            return len(done)
+        sc = set(scope)
+        return sum(1 for rec in done.values() if rec["owner"] in sc)
+
+    def _poll_straggler(self, phase: str) -> None:
+        """Real-path straggler detection: compare live peers' lease
+        progress clocks; a confirmed (post-dwell) verdict on a PEER
+        raises :class:`StragglerDetected` for the hedge path.  A verdict
+        on ourselves is ignored — a straggler cannot hedge itself."""
+        mv = self.membership
+        board = mv.board
+        live = [r for r in mv.survivors if r in set(board.discover())
+                or r < board.num_ranks]
+        progress = board_progress(board, live)
+        if len(progress) < 2:
+            return
+        num_p = self.config.network_partition_count
+        share = max(1, num_p // max(1, len(progress)))
+        outstanding = {r: max(0, share - done)
+                       for r, done in progress.items()}
+        verdict = self._detector().observe(progress, outstanding)
+        if verdict is not None and verdict.rank != board.rank:
+            raise verdict.to_exc(mv.epoch)
+
+    def _compute_straggle(self) -> None:
+        """The ``compute.straggle`` site fired: the highest node rank
+        slows by ``straggle_factor`` x ``straggle_unit_s``.  Unhedged,
+        the join simply eats the stretch (tail latency — the failure
+        mode).  With hedging on, the spin feeds the detector a simulated
+        progress picture (healthy ranks at their share, the straggler at
+        its manifest progress) and aborts into the hedge as soon as the
+        post-dwell verdict lands — tail becomes detect + recompute."""
+        m = self.measurements
+        n = self.config.num_nodes
+        victim = n - 1
+        factor = max(0.0, float(self.straggle_factor))
+        duration = factor * self.straggle_unit_s
+        if m is not None:
+            m.event("straggle", rank=victim, factor=factor,
+                    duration_s=round(duration, 3))
+        if duration <= 0:
+            return
+        hedging = self._should_hedge()
+        num_p = self.config.network_partition_count
+        share = max(1, num_p // n)
+        detector = self._detector() if hedging else None
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < duration:
+            if hedging:
+                done = self.partition_manifest.completed()
+                victim_done = sum(1 for p, rec in done.items()
+                                  if p % n == victim)
+                progress = {r: share for r in range(n) if r != victim}
+                progress[victim] = victim_done
+                outstanding = {victim: max(0, share - victim_done)}
+                verdict = detector.observe(progress, outstanding)
+                if verdict is not None:
+                    epoch = self._membership_epoch()
+                    if m is not None and "JTOTAL" in m._starts:
+                        m.stop("JTOTAL")
+                    raise verdict.to_exc(epoch)
+            time.sleep(min(0.02, duration / 4))
+
     def _as_rank_lost(self, e: BaseException) -> Optional[RankLost]:
         """Map a mid-join failure to the :class:`RankLost` recovery owns.
 
@@ -2004,9 +2178,10 @@ class HashJoin:
                                    TimeoutError))):
             # a peer's death can surface as a transport error BEFORE its
             # lease ages out (RST beats the lapse window): give the lease
-            # one full window to lapse before disowning the error
+            # one full window — lease_s x missed_beats, the two-missed-
+            # beats rule — to lapse before disowning the error
             mv = self.membership
-            deadline = time.monotonic() + mv.board.lease_s + 1.0
+            deadline = time.monotonic() + mv.board.lapse_window_s + 1.0
             while True:
                 lost = mv.check() or sorted(mv.lost)
                 if lost or time.monotonic() >= deadline:
@@ -2051,14 +2226,105 @@ class HashJoin:
         me = mv.board.rank
         return range(me * npp, (me + 1) * npp)
 
+    def _joined_nodes(self) -> list:
+        """Expand admitted PROCESS ranks into the node ranks they bring —
+        the growth mirror of :meth:`_lost_nodes` (same npp convention).
+        Joined ids may lie beyond the boot mesh's node range; they are
+        assignment/owner labels for the out-of-band recompute path, not
+        device indices."""
+        mv = self.membership
+        if mv is None or not mv.joined:
+            return []
+        n = self.config.num_nodes
+        npp = max(1, n // max(1, mv.board.num_ranks))
+        out = []
+        for pr in sorted(mv.joined):
+            out.extend(range(pr * npp, (pr + 1) * npp))
+        return sorted(set(out))
+
+    def _straggler_nodes(self, exc) -> list:
+        """Node ranks the straggler owns.  A verdict rank below the
+        process count is a PROCESS rank (real-path detection off lease
+        progress clocks) and expands npp-wise like :meth:`_lost_nodes`;
+        at or beyond it, it is already a node rank (the in-process
+        ``compute.straggle`` simulation's victim)."""
+        n = self.config.num_nodes
+        mv = self.membership
+        rk = int(exc.rank)
+        if (mv is not None and mv.board.num_ranks > 1
+                and rk < mv.board.num_ranks):
+            npp = max(1, n // mv.board.num_ranks)
+            return [x for x in range(rk * npp, (rk + 1) * npp) if x < n]
+        return [rk if 0 <= rk < n else n - 1]
+
+    def _claim_hedge(self, plan, straggler_nodes, epoch: int) -> list:
+        """Advisory hedge claims: before recomputing, claim the
+        straggler's unfinished partitions in the manifest so a crash
+        mid-hedge leaves a forensic trail (the post-mortem hedge-claim
+        timeline) and a concurrent hedger can see the race.  The
+        done-line fence — not the claim — remains the count arbiter."""
+        mf = self.partition_manifest
+        n = self.config.num_nodes
+        strag = set(straggler_nodes)
+        hedged = [p for p in plan.recompute if p % n in strag]
+        scope = self._recovery_scope()
+        mine = None if scope is None else set(scope)
+        for p in hedged:
+            owner = plan.reassignment[p]
+            if mine is None or owner in mine:
+                mf.claim(p, owner, epoch=epoch)
+        return hedged
+
+    def _await_peer_partitions(self, plan, counts, rk, sk, rhi, shi):
+        """Multi-survivor completeness: partitions the plan reassigned to
+        OTHER live processes (an incumbent peer or a newcomer) may not
+        have landed yet — poll the shared manifest for one lapse window,
+        then recompute any leftovers locally.  Deterministic inputs make
+        the local recompute exact and the manifest fence makes the
+        double-compute safe, so waiting never blocks correctness."""
+        mv, mf = self.membership, self.partition_manifest
+        missing = [p for p in plan.recompute if p not in counts]
+        if not missing or mf is None or mv is None:
+            return counts
+        deadline = time.monotonic() + mv.board.lapse_window_s + 1.0
+        while missing and time.monotonic() < deadline:
+            done = mf.completed()
+            for p in list(missing):
+                if p in done:
+                    counts[p] = done[p]["count"]
+                    missing.remove(p)
+            if missing:
+                time.sleep(0.2)
+        if missing:
+            from tpu_radix_join.robustness import recovery as _recovery
+            owners = {plan.reassignment[p] for p in missing}
+            _, extra = _recovery.execute_recovery(
+                plan, rk, sk, rhi, shi, only_rank=owners,
+                slab=min(1 << 20, max(1, len(sk))),
+                pipeline=self.config.grid_pipeline,
+                measurements=self.measurements, manifest=mf)
+            counts.update(extra)
+        return counts
+
     def _recover_join(self, r: TupleBatch, s: TupleBatch, exc: RankLost,
-                      repeats: int) -> JoinResult:
+                      repeats: int, *, lost_nodes=None, joined_nodes=None,
+                      epoch=None, span_name: str = "recovery",
+                      hedge_exc=None, extra_diag=None) -> JoinResult:
         """Finish an aborted join on the survivor mesh (the elastic
         tentpole, robustness/recovery.py): resume realized partitions
-        from the manifest, re-assign the rest across survivors, recompute
-        each as its own masked out-of-core join from host-regenerated
-        inputs, and splice — ok=True with the exact count, classified
-        ``recovered`` diagnostics, never a collective on the old mesh."""
+        from the manifest, re-assign the rest across survivors — a set
+        that may have GROWN through ``joining``-lease admissions
+        (``joined_nodes``) — recompute each as its own masked
+        out-of-core join from host-regenerated inputs, and splice —
+        ok=True with the exact count, classified ``recovered``
+        diagnostics, never a collective on the old mesh.
+
+        Also the shared engine behind :meth:`_regrow_join` (growth: zero
+        losses, the admission's fenced epoch) and :meth:`_hedge_join`
+        (straggler hedge: ``lost_nodes`` is an assignment EXCLUSION only
+        — nothing is declared lost, no epoch bump, the recompute fences
+        at the current epoch and the manifest arbitrates against the
+        still-running original)."""
         m = self.measurements
         cfg = self.config
         num_p = cfg.network_partition_count
@@ -2081,9 +2347,13 @@ class HashJoin:
             raise exc
         if m is not None and "JTOTAL" in m._starts:
             m.stop("JTOTAL")   # the abort point; recovery has its own wall
-        epoch = max(1, self._membership_epoch(),
-                    int(getattr(exc, "epoch", 1)))
-        lost_nodes = self._lost_nodes(exc)
+        if epoch is None:
+            epoch = max(1, self._membership_epoch(),
+                        int(getattr(exc, "epoch", 1)))
+        if lost_nodes is None:
+            lost_nodes = self._lost_nodes(exc)
+        if joined_nodes is None:
+            joined_nodes = self._joined_nodes()
         # advisory re-pricing for the shrunken mesh: best-effort — a
         # missing profile must not block recovery
         profile = workload = None
@@ -2098,7 +2368,7 @@ class HashJoin:
                                 num_nodes=cfg.num_nodes)
         except Exception:   # noqa: BLE001 — advisory only
             profile = workload = None
-        span = (m.span("recovery", epoch=epoch,
+        span = (m.span(span_name, epoch=epoch,
                        lost_ranks=list(lost_nodes))
                 if m is not None else contextlib.nullcontext())
         with span:
@@ -2107,18 +2377,38 @@ class HashJoin:
                 lost_ranks=lost_nodes, epoch=epoch,
                 manifest=self.partition_manifest,
                 weights=_recovery.partition_weights(rk, sk, num_p),
-                profile=profile, workload=workload)
+                profile=profile, workload=workload,
+                joined_ranks=joined_nodes)
+            hedged_parts = []
+            if hedge_exc is not None and self.partition_manifest is not None:
+                hedged_parts = self._claim_hedge(plan, lost_nodes, epoch)
             matches, counts = _recovery.execute_recovery(
                 plan, rk, sk, rhi, shi,
                 only_rank=self._recovery_scope(),
                 slab=min(1 << 20, max(1, len(sk))),
                 pipeline=cfg.grid_pipeline, measurements=m,
                 manifest=self.partition_manifest)
+            counts = self._await_peer_partitions(plan, counts,
+                                                 rk, sk, rhi, shi)
+            matches = int(sum(counts.values()))
         counts_out = np.zeros(num_p, np.uint32)
         for p, c in counts.items():
             counts_out[p] = c % (1 << 32)
         diag = dict(plan.to_diag(), rank_lost_detail=str(exc)[:200],
                     failure_class="ok")
+        if hedge_exc is not None and self.partition_manifest is not None:
+            # score the speculation against the fence winners: wins are
+            # hedged partitions someone OTHER than the straggler realized
+            score = {"hedgewin": 0, "specwaste": 0}
+            for node in sorted(set(lost_nodes)):
+                sub = [p for p in hedged_parts
+                       if p % cfg.num_nodes == node]
+                sc = score_hedge(self.partition_manifest, sub, node, m)
+                score["hedgewin"] += sc["hedgewin"]
+                score["specwaste"] += sc["specwaste"]
+            diag.update(score, hedged_partitions=len(hedged_parts))
+        if extra_diag:
+            diag.update(extra_diag)
         self._stamp_fault_sites(diag)
         if m is not None:
             m.incr("RESULTS", matches * repeats)
@@ -2127,6 +2417,48 @@ class HashJoin:
             m.derive_rates()
         return JoinResult(matches=matches, ok=True,
                           partition_counts=counts_out, diagnostics=diag)
+
+    def _regrow_join(self, r: TupleBatch, s: TupleBatch, exc,
+                     repeats: int) -> JoinResult:
+        """:class:`RankJoined` landed mid-join (``--elastic-grow``): the
+        membership GREW, so finish the aborted join over the enlarged
+        set — the same resume/re-assign/recompute engine as rank loss
+        with zero losses and the admission's fenced epoch.  The newcomer
+        computes the same deterministic host keys every incumbent does,
+        takes its reassigned share, and the shared manifest merges the
+        totals (:meth:`_await_peer_partitions` waits for them)."""
+        m = self.measurements
+        if m is not None:
+            m.event("regrow", joined_ranks=list(exc.ranks),
+                    epoch=int(exc.epoch))
+        epoch = max(1, int(exc.epoch), self._membership_epoch())
+        return self._recover_join(
+            r, s, exc, repeats, lost_nodes=[], epoch=epoch,
+            span_name="regrow",
+            extra_diag={"regrown": True,
+                        "joined_ranks_admitted": list(exc.ranks)})
+
+    def _hedge_join(self, r: TupleBatch, s: TupleBatch, exc,
+                    repeats: int) -> JoinResult:
+        """:class:`StragglerDetected` (hedging on): speculatively finish
+        the straggler's unfinished partitions WITHOUT declaring anyone
+        lost.  The straggler's nodes are excluded from the reassignment
+        only — membership untouched, no epoch bump — and the recompute
+        fences at the current epoch, so if the original lands a
+        partition first the hedge's line is fenced out
+        (hedge-never-double-counts) and scores as SPECWASTE."""
+        m = self.measurements
+        strag_nodes = self._straggler_nodes(exc)
+        if m is not None:
+            m.incr(HEDGED)
+            m.event("hedge", straggler=int(exc.rank), nodes=strag_nodes,
+                    progress=int(exc.progress), median=float(exc.median),
+                    outstanding=int(exc.outstanding))
+        epoch = max(self._membership_epoch(), int(exc.epoch))
+        return self._recover_join(
+            r, s, exc, repeats, lost_nodes=strag_nodes, epoch=epoch,
+            span_name="hedge", hedge_exc=exc,
+            extra_diag={"hedged": True, "straggler": int(exc.rank)})
 
     def _manifest_record(self, result: JoinResult) -> None:
         """Join-epilogue manifest write: record every realized partition

@@ -76,6 +76,37 @@ def make_survivor_mesh(lost_nodes: Sequence[int],
     return Mesh(np.asarray(alive), (axis_name,))
 
 
+def make_elastic_mesh(lost_nodes: Sequence[int],
+                      joined_nodes: Sequence[int] = (),
+                      num_nodes: int | None = None,
+                      axis_name: str = "nodes") -> Mesh:
+    """:func:`make_survivor_mesh` extended over a membership that may
+    have GROWN: ``joined_nodes`` are node ids the membership view
+    admitted beyond (or back into) the boot mesh.  Joined ids inside the
+    boot range re-take their original device slot (a readmitted rank);
+    ids beyond it map onto the process's spare devices past the boot
+    mesh when any exist (the single-process virtual-device simulation),
+    and are otherwise dropped from the dispatchable grid — in a real
+    multi-process job the newcomer's devices live in its own process, so
+    the helper documents the target shape while the out-of-band
+    recompute path (robustness/recovery.py) does the actual work
+    host-side, same caveat as :func:`make_survivor_mesh`."""
+    devs = jax.devices()
+    n = num_nodes or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} nodes but only {len(devs)} devices")
+    lost = {int(r) for r in lost_nodes}
+    joined = sorted({int(r) for r in joined_nodes} - lost)
+    grid = [d for i, d in enumerate(devs[:n]) if i not in lost or i in joined]
+    spare = list(devs[n:])
+    for j in joined:
+        if j >= n and spare:
+            grid.append(spare.pop(0))
+    if not grid:
+        raise ValueError(f"all {n} nodes lost — no elastic mesh to build")
+    return Mesh(np.asarray(grid), (axis_name,))
+
+
 def make_hierarchical_mesh(
     num_hosts: int,
     num_nodes: int | None = None,

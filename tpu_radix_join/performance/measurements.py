@@ -112,6 +112,17 @@ RECOVERN = "RECOVERN"      # partitions recomputed during elastic recovery
                            # count means resume was partition-granular
 RECOVERMS = "RECOVERMS"    # total elastic-recovery wall milliseconds (detect ->
                            # re-plan -> recompute -> splice)
+RANKJOIN = "RANKJOIN"      # ranks admitted from a `joining` lease — the growth
+                           # mirror of RANKLOST (robustness/membership.py)
+HEDGED = "HEDGED"          # straggler hedges launched: speculative out-of-band
+                           # recomputes of a slow-but-alive rank's unfinished
+                           # partitions (robustness/straggler.py)
+HEDGEWIN = "HEDGEWIN"      # hedged partitions whose speculative recompute won
+                           # the manifest's first-writer-wins fence — the
+                           # original never double-counts past these
+SPECWASTE = "SPECWASTE"    # hedged partitions whose claim LOST (the original
+                           # owner's realized line landed first): wasted
+                           # speculative work, the hedging overhead gauge
 JXAUDIT = "JXAUDIT"        # gauge: live graftcheck (jaxpr IR audit) findings
                            # on the traced entry points — the static twin of
                            # the lint gate; lower is better, clean repo holds 0

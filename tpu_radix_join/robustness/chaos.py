@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import random
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -273,19 +274,34 @@ def soak(runs: int, base_seed: int = 0, runner: Optional[ChaosRunner] = None,
 
 
 #: the elastic-recovery soak vocabulary: every array-path site PLUS the
-#: rank-death site (consulted at every ``_check_cancel`` phase boundary —
-#: hit 1 is "start", 2 is "sized", 3+ are the per-attempt "probe"
-#: boundaries, so a seeded hit index IS a seeded phase boundary)
-RECOVERY_SITES: Tuple[str, ...] = CHAOS_SITES + (faults.RANK_DEATH,)
+#: membership sites — rank death and rank join (both consulted at every
+#: ``_check_cancel`` phase boundary — hit 1 is "start", 2 is "sized", 3+
+#: are the per-attempt "probe" boundaries, so a seeded hit index IS a
+#: seeded phase boundary) — and the compute-straggle site (consulted
+#: once per attempt, inside the pipeline)
+RECOVERY_SITES: Tuple[str, ...] = CHAOS_SITES + (
+    faults.RANK_DEATH, faults.RANK_JOIN, faults.COMPUTE_STRAGGLE)
 
 
 def generate_recovery_schedule(seed: int) -> Schedule:
     """Always one ``membership.rank_death`` arm at a seeded phase
     boundary (``at`` in 1..3 — start/sized/probe), plus 0-2 arms from
     :data:`CHAOS_SITES` so rank loss composes with the faults it can
-    race (a corruption before the death, an overflow retry around it)."""
+    race (a corruption before the death, an overflow retry around it).
+
+    The membership interleavings ride the same seed: roughly half the
+    schedules also arm ``membership.rank_join`` at its own seeded
+    boundary (join-during-recovery when the admission lands around the
+    death's boundary), and roughly half arm ``compute.straggle``
+    (straggle-then-die: a live-but-slow rank races the death — whichever
+    site's boundary fires first owns the abort, and the invariant is the
+    same either way: oracle-exact or classified, never a double count)."""
     rng = random.Random(seed)
     arms = [(faults.RANK_DEATH, (("at", rng.randint(1, 3)),))]
+    if rng.random() < 0.5:
+        arms.append((faults.RANK_JOIN, (("at", rng.randint(1, 3)),)))
+    if rng.random() < 0.5:
+        arms.append((faults.COMPUTE_STRAGGLE, (("at", 1),)))
     for site in rng.sample(CHAOS_SITES, rng.randint(0, 2)):
         at = rng.randint(1, 2) if site == faults.SHUFFLE_OVERFLOW else 1
         arms.append((site, (("at", at),)))
@@ -302,7 +318,18 @@ class RecoveryChaosRunner(ChaosRunner):
     shrinks to 8 network partitions (``network_fanout_bits=3``): each
     recovered partition is its own masked out-of-core join, and partition
     count is the knob that bounds the soak's recompute wall.
-    """
+
+    The growth/hedging sites get real state per run (:meth:`_bind`): a
+    fresh single-process membership view (so ``membership.rank_join``
+    admissions land in a clean epoch sequence) with ``elastic_grow`` on,
+    and a fresh :class:`PartitionManifest` (the hedge's fence).  The
+    straggle slowdown factor is seeded per schedule
+    (``random.Random(f"{seed}:straggle")`` — the faults.py determinism
+    convention) and hedging is on, so a fired ``compute.straggle``
+    exercises detect→hedge→score instead of just sleeping.  After every
+    run the manifest is audited: a PASS whose winning-line total differs
+    from the oracle is a double-count — a VIOLATION even though the
+    splice looked right (the invariant hedge-never-double-counts)."""
 
     def __init__(self, num_nodes: int = 4, size: int = 1 << 11,
                  verify: str = "check", data_seed: int = 0,
@@ -314,9 +341,45 @@ class RecoveryChaosRunner(ChaosRunner):
                          data_seed=data_seed, config_overrides=overrides,
                          bundle_dir=bundle_dir)
         self.engine.elastic = True
+        self.engine.elastic_grow = True
+        self.engine.hedge = "on"
+        self.engine.straggle_unit_s = 0.02   # bounded soak wall
+        self.audits: List[Dict[str, Any]] = []   # one manifest audit per run
 
     def _bind(self, m) -> None:
+        import tempfile
+
+        from tpu_radix_join.robustness.checkpoint import PartitionManifest
+        from tpu_radix_join.robustness.membership import (LeaseBoard,
+                                                          MembershipView)
         self.engine.measurements = m
+        # fresh membership + manifest per run: epochs, admissions, and
+        # fence lines must not leak across schedules (a large lease so an
+        # injected joiner's one-shot lease never lapses mid-soak)
+        run_dir = tempfile.mkdtemp(prefix="tpu_rj_chaos_")
+        board = LeaseBoard(run_dir, rank=0, num_ranks=1, lease_s=300.0,
+                           measurements=m)
+        self.engine.membership = MembershipView(board, measurements=m)
+        self.engine.partition_manifest = PartitionManifest(
+            os.path.join(run_dir, "parts.manifest"),
+            fingerprint={"chaos_oracle": self.oracle}, measurements=m)
+
+    def run(self, schedule: Schedule) -> RunOutcome:
+        self.engine.straggle_factor = random.Random(
+            f"{schedule.seed}:straggle").uniform(2.0, 6.0)
+        out = super().run(schedule)
+        aud = self.engine.partition_manifest.audit()
+        self.audits.append(aud)
+        if out.status == PASS and aud["total"] != self.oracle:
+            out = dataclasses.replace(
+                out, status=VIOLATION,
+                detail=f"manifest double-count: winning lines sum to "
+                       f"{aud['total']} != oracle {self.oracle} "
+                       f"(fenced_duplicates={aud['fenced_duplicates']})")
+            out = dataclasses.replace(out, bundle=_violation_bundle(
+                self.measurements[-1], schedule, out.detail,
+                self.bundle_dir))
+        return out
 
 
 def soak_recovery(runs: int, base_seed: int = 0,
@@ -327,9 +390,16 @@ def soak_recovery(runs: int, base_seed: int = 0,
     the base invariant fields: ``ranklost``/``recovered_partitions``/
     ``max_epoch`` totals across the soak, and ``wdogtrip`` — which must
     stay 0 (a recovered run never books a watchdog death; a nonzero
-    value means a stall was killed instead of triaged)."""
-    from tpu_radix_join.performance.measurements import (MEPOCH, RANKLOST,
-                                                         RECOVERN, WDOGTRIP)
+    value means a stall was killed instead of triaged).  The growth and
+    hedging arms add their own: ``rankjoin`` (admissions), ``hedged`` /
+    ``hedgewin`` / ``specwaste`` (speculation accounting), and
+    ``manifest_exact`` — runs whose post-run manifest audit summed
+    exactly to the oracle (the zero-double-count invariant; audited
+    mismatches on PASS runs are already VIOLATIONs)."""
+    from tpu_radix_join.performance.measurements import (HEDGED, HEDGEWIN,
+                                                         MEPOCH, RANKJOIN,
+                                                         RANKLOST, RECOVERN,
+                                                         SPECWASTE, WDOGTRIP)
     runner = runner or RecoveryChaosRunner()
     outcomes = []
     for i in range(runs):
@@ -348,11 +418,18 @@ def soak_recovery(runs: int, base_seed: int = 0,
         "failure_classes": sorted({o.failure_class for o in outcomes
                                    if o.failure_class}),
         "ranklost": sum(int(m.counters.get(RANKLOST, 0)) for m in regs),
+        "rankjoin": sum(int(m.counters.get(RANKJOIN, 0)) for m in regs),
+        "hedged": sum(int(m.counters.get(HEDGED, 0)) for m in regs),
+        "hedgewin": sum(int(m.counters.get(HEDGEWIN, 0)) for m in regs),
+        "specwaste": sum(int(m.counters.get(SPECWASTE, 0)) for m in regs),
         "recovered_partitions": sum(int(m.counters.get(RECOVERN, 0))
                                     for m in regs),
         "max_epoch": max((int(m.counters.get(MEPOCH, 0)) for m in regs),
                          default=0),
         "wdogtrip": sum(int(m.counters.get(WDOGTRIP, 0)) for m in regs),
+        "manifest_exact": sum(
+            a["total"] == runner.oracle
+            for a in getattr(runner, "audits", [])[-runs:]),
     }
     return outcomes, summary
 

@@ -254,9 +254,17 @@ class PartitionManifest:
     Recovery (robustness/recovery.py) reads :meth:`completed` to skip
     every realized partition and recompute exactly the lost rank's
     unfinished ones; the ``owner``/``epoch`` stamps make the recovery
-    timeline reconstructible in post-mortem bundles.  Later lines win on
-    a per-partition key (a partition re-realized at a newer epoch
-    supersedes its old entry).
+    timeline reconstructible in post-mortem bundles.
+
+    **Fencing (hedge-never-double-counts)** — per partition, a line at a
+    strictly newer epoch supersedes (a partition re-realized after a
+    membership change owns its new count), but within one epoch the
+    FIRST writer wins: when a straggler hedge (robustness/straggler.py)
+    realizes a partition before its slow original owner does, the
+    original's late line is dead on arrival — read-side arbitration, so
+    two uncoordinated appenders can never sum the same partition twice.
+    :meth:`claim` records hedge intent (forensics + the HEDGEWIN /
+    SPECWASTE split); the *done* line remains the only count arbiter.
     """
 
     def __init__(self, path: str, fingerprint: dict, measurements=None):
@@ -337,8 +345,11 @@ class PartitionManifest:
 
     def completed(self) -> Dict[int, dict]:
         """``{partition: {"count", "owner", "epoch"}}`` of every realized
-        partition (later lines win); torn/corrupt lines are skipped —
-        the kill-never-overclaims read side."""
+        partition; torn/corrupt lines are skipped — the
+        kill-never-overclaims read side.  Arbitration per partition: a
+        strictly newer epoch supersedes, and within one epoch the first
+        writer wins (the hedge fence — a late-finishing original can
+        never displace the speculative count that already landed)."""
         out: Dict[int, dict] = {}
         try:
             with open(self.path) as f:
@@ -348,10 +359,102 @@ class PartitionManifest:
         for line in lines[1:]:
             try:
                 rec = json.loads(line)
-                out[int(rec["partition"])] = {
-                    "count": int(rec["count"]),
-                    "owner": int(rec["owner"]),
-                    "epoch": int(rec.get("epoch", 0))}
+                if "count" not in rec:
+                    continue        # claim line, not a done line
+                p = int(rec["partition"])
+                ep = int(rec.get("epoch", 0))
+                if p in out and ep <= out[p]["epoch"]:
+                    continue        # first writer already won this epoch
+                out[p] = {"count": int(rec["count"]),
+                          "owner": int(rec["owner"]), "epoch": ep}
             except (ValueError, KeyError, json.JSONDecodeError):
                 continue
         return out
+
+    # ------------------------------------------------------------- claims
+    def claim(self, partition: int, owner: int, epoch: int = 0) -> bool:
+        """Record hedge intent on a partition; returns True when this
+        ``(owner, epoch)`` holds the claim (first claimant at the highest
+        epoch), False when a rival claimed it first or the partition is
+        already done at ``epoch`` or newer.  Claims are advisory — they
+        split HEDGEWIN from SPECWASTE and render in the post-mortem
+        timeline — while the *done*-line fence in :meth:`completed`
+        remains the count arbiter, so a lost claim race can waste work
+        but never double-count."""
+        m = self.measurements
+        done = self.completed().get(int(partition))
+        if done is not None and done["epoch"] >= int(epoch):
+            return False
+        holder = self.claims().get(int(partition))
+        if holder is not None and holder["epoch"] >= int(epoch):
+            return (holder["owner"] == int(owner)
+                    and holder["epoch"] == int(epoch))
+        rec = {"partition": int(partition), "claim": True,
+               "owner": int(owner), "epoch": int(epoch)}
+        try:
+            with open(self.path, "a") as f:
+                json.dump(rec, f)
+                f.write("\n")
+                f.flush()
+                os.fsync(f.fileno())
+        except OSError as e:
+            if m is not None:
+                m.event("manifest_append_failed", path=self.path,
+                        error=repr(e))
+            return False
+        if m is not None:
+            m.event("hedge_claim", partition=int(partition),
+                    owner=int(owner), epoch=int(epoch))
+        return True
+
+    def claims(self) -> Dict[int, dict]:
+        """``{partition: {"owner", "epoch"}}`` of every claimed partition,
+        arbitrated like :meth:`completed` (newer epoch supersedes, first
+        claimant wins within an epoch)."""
+        out: Dict[int, dict] = {}
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            return out
+        for line in lines[1:]:
+            try:
+                rec = json.loads(line)
+                if not rec.get("claim"):
+                    continue
+                p = int(rec["partition"])
+                ep = int(rec.get("epoch", 0))
+                if p in out and ep <= out[p]["epoch"]:
+                    continue
+                out[p] = {"owner": int(rec["owner"]), "epoch": ep}
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+        return out
+
+    def audit(self) -> dict:
+        """The double-count audit the chaos soak asserts on: the fenced
+        total (sum of winning counts), plus every partition where a
+        second writer's same-epoch line was fenced out — absorbed
+        double-count attempts, each one a would-have-been wrong total."""
+        winners = self.completed()
+        fenced: Dict[int, int] = {}
+        try:
+            with open(self.path) as f:
+                lines = f.readlines()
+        except OSError:
+            lines = []
+        for line in lines[1:]:
+            try:
+                rec = json.loads(line)
+                if "count" not in rec:
+                    continue
+                p = int(rec["partition"])
+                win = winners.get(p)
+                if (win is not None and int(rec.get("epoch", 0)) == win["epoch"]
+                        and int(rec["owner"]) != win["owner"]):
+                    fenced[p] = fenced.get(p, 0) + 1
+            except (ValueError, KeyError, json.JSONDecodeError):
+                continue
+        return {"total": sum(rec["count"] for rec in winners.values()),
+                "partitions": len(winners),
+                "fenced_duplicates": fenced}

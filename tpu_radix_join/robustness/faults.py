@@ -59,10 +59,24 @@ RANK_DEATH = "membership.rank_death"           # peer rank dies mid-run: its
                                                # the epoch + recover instead
                                                # of hanging (robustness/
                                                # membership.py + recovery.py)
+RANK_JOIN = "membership.rank_join"             # a new peer writes a `joining`
+                                               # lease mid-run: the view admits
+                                               # it with a fenced epoch bump
+                                               # and the next plan re-expands
+                                               # onto the grown membership
+                                               # (membership.py + recovery.py)
+COMPUTE_STRAGGLE = "compute.straggle"          # a live rank slows down by a
+                                               # seeded factor: alive-but-slow
+                                               # is NOT rank_death — the
+                                               # straggler detector must hedge
+                                               # its unfinished partitions,
+                                               # never declare it dead
+                                               # (robustness/straggler.py)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
-         CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL, RANK_DEATH)
+         CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL, RANK_DEATH,
+         RANK_JOIN, COMPUTE_STRAGGLE)
 
 
 class InjectedFault(RuntimeError):

@@ -9,15 +9,18 @@ turns the aborted join into a bounded recompute instead of a restart:
      before the death is *done*, its count is trusted (every manifest
      line is written post-realization, so trusting it can never
      overclaim).
-  2. **Re-plan on shrink** — the not-done partitions are re-assigned
-     across the survivor set with the same deterministic machinery the
-     boot mesh used (``histograms/assignment_map``): load-aware LPT over
-     measured per-partition weights when histograms are available,
-     round-robin otherwise.  Every survivor computes the identical map
-     from the shared lease/manifest state — no coordinator.  The planner
-     re-prices strategies for the shrunken mesh (`plan_join` on a
-     ``num_nodes=len(survivors)`` workload) so the post-recovery steady
-     state doesn't run the old mesh's plan.
+  2. **Re-plan on shrink OR growth** — the not-done partitions are
+     re-assigned across the survivor set with the same deterministic
+     machinery the boot mesh used (``histograms/assignment_map``):
+     load-aware LPT over measured per-partition weights when histograms
+     are available, round-robin otherwise.  ``joined_ranks`` (admitted
+     via the membership view's ``joining``-lease protocol) enlarge the
+     survivor set, so an admission re-expands the map onto the newcomer
+     exactly as a loss shrinks it.  Every survivor computes the
+     identical map from the shared lease/manifest state — no
+     coordinator.  The planner re-prices strategies for the changed mesh
+     (`plan_join` on a ``num_nodes=len(survivors)`` workload) so the
+     post-recovery steady state doesn't run the old mesh's plan.
   3. **Recompute out-of-band** — each unfinished partition re-joins as
      its own masked ``chunked_join_grid`` (``(key & (P-1)) == p``), the
      exact machinery ``verify="repair"`` already trusts, over inputs
@@ -88,7 +91,8 @@ class RecoveryPlan:
 def plan_recovery(*, num_nodes: int, num_partitions: int,
                   lost_ranks, epoch: int, manifest=None,
                   weights: Optional[np.ndarray] = None,
-                  profile=None, workload=None) -> RecoveryPlan:
+                  profile=None, workload=None,
+                  joined_ranks=()) -> RecoveryPlan:
     """Build the survivor-side :class:`RecoveryPlan`.
 
     ``manifest`` (checkpoint.PartitionManifest) supplies resumable
@@ -96,10 +100,20 @@ def plan_recovery(*, num_nodes: int, num_partitions: int,
     length ``num_partitions``) switches the reassignment from
     round-robin to load-aware LPT; ``profile``/``workload``
     (planner.profile.DeviceProfile, planner.cost_model.Workload) trigger
-    the shrunken-mesh re-pricing.
-    """
+    the re-pricing for the changed mesh.
+
+    ``joined_ranks`` is the growth half: ranks the membership view
+    admitted beyond (or back into) the boot mesh.  The survivor set —
+    and with it the deterministic reassignment and the planner's
+    re-priced workload — expands over the enlarged membership, so the
+    next epoch's plan prices and assigns partitions onto the newcomer
+    (safe because recovery inputs are regenerated from the deterministic
+    seeded Relation specs: a newcomer computes the same
+    :func:`host_keys` every incumbent does, no foreign-mesh arrays are
+    touched)."""
     lost = tuple(sorted(set(int(r) for r in lost_ranks)))
-    survivors = tuple(r for r in range(num_nodes) if r not in lost)
+    members = set(range(num_nodes)) | {int(r) for r in joined_ranks}
+    survivors = tuple(sorted(members - set(lost)))
     if not survivors:
         raise RankLost(lost[0] if lost else 0, epoch,
                        "no survivors to recover onto")

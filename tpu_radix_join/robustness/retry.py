@@ -78,6 +78,7 @@ BACKEND_UNAVAILABLE = "backend_unavailable"
 ADMISSION_REJECTED = "admission_rejected"
 DEADLINE_EXCEEDED = "deadline_exceeded"
 RANK_LOST = "rank_lost"
+RANK_JOIN = "rank_join"
 PLAN_INFEASIBLE = "plan_infeasible"
 
 #: diagnostics flags -> class, in priority order (fatal classes outrank

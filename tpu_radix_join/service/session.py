@@ -140,7 +140,8 @@ class JoinSession:
                  clock: Callable[[], float] = time.monotonic,
                  forensics_dir: Optional[str] = None,
                  ledger=None, membership=None, elastic: bool = False,
-                 partition_manifest=None):
+                 partition_manifest=None, elastic_grow: bool = False,
+                 hedge: str = "off", hedge_threshold: float = 0.5):
         from tpu_radix_join.operators.hash_join import HashJoin
 
         self.config = config
@@ -156,6 +157,12 @@ class JoinSession:
         self.membership = membership
         self.elastic = elastic
         self.partition_manifest = partition_manifest
+        #: growth + hedging posture, threaded like membership: a session
+        #: can admit ranks (elastic_grow) and speculate on stragglers
+        #: (hedge/hedge_threshold) on any engine it builds
+        self.elastic_grow = elastic_grow
+        self.hedge = hedge
+        self.hedge_threshold = hedge_threshold
         self.service = service or ServiceConfig()
         self.measurements = measurements
         #: cross-run telemetry ledger (observability/ledger.py): when set,
@@ -258,6 +265,9 @@ class JoinSession:
         engine.membership = self.membership
         engine.elastic = self.elastic
         engine.partition_manifest = self.partition_manifest
+        engine.elastic_grow = self.elastic_grow
+        engine.hedge = self.hedge
+        engine.hedge_threshold = self.hedge_threshold
 
     def _degraded_engine(self):
         """The CPU fallback engine, built once on first use (the breaker's
