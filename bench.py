@@ -791,6 +791,131 @@ def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
     return 0
 
 
+def _run_critpath_bench(check_baseline=None, size=1 << 20, iters=5):
+    """``--critpath-bench``: instrumentation-overhead A/B for the
+    critical-path attribution plane (observability/critpath.py +
+    statusz.py).
+
+    Two arms of the same 1M x 1M 8-way host-CPU join: the BARE arm runs
+    with the registry alone (the pre-observability posture); the
+    INSTRUMENTED arm attaches the span tracer, keeps a live ``/statusz``
+    endpoint up and polls it once per join (the operator's heartbeat
+    query), and reconstructs the critical path after every join — the
+    full cost of the introspection plane under load.  Per-arm walls are
+    per-iteration medians, so one scheduler hiccup cannot fake a
+    regression.  The headline ``value`` is instrumented throughput;
+    ``critpath_overhead_pct`` and the path's ``wait_fraction`` gate
+    lower-is-better under tools_check_regress.py.  Exit 3 when either
+    arm misses the oracle or the overhead exceeds the 1%% acceptance
+    bar."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import urllib.request
+
+    import jax.numpy as jnp
+    from tpu_radix_join.core.config import JoinConfig
+    from tpu_radix_join.data.tuples import TupleBatch
+    from tpu_radix_join.observability.critpath import (
+        critical_path_from_tracer)
+    from tpu_radix_join.observability.statusz import (StatuszServer,
+                                                      measurements_sections)
+    from tpu_radix_join.operators.hash_join import HashJoin
+    from tpu_radix_join.performance import Measurements
+
+    nodes, n = 8, size
+    cfg = JoinConfig(num_nodes=nodes)
+    rng = np.random.default_rng(29)
+    rk = (rng.permutation(n) + 1).astype(np.uint32)
+    sk = rng.integers(1, n + 1, size=n).astype(np.uint32)
+    rid = np.arange(n, dtype=np.uint32)
+    r = TupleBatch(key=jnp.asarray(rk), rid=jnp.asarray(rid))
+    s = TupleBatch(key=jnp.asarray(sk), rid=jnp.asarray(rid))
+
+    def median(vals):
+        vs = sorted(vals)
+        return vs[len(vs) // 2]
+
+    def bare_arm():
+        meas = Measurements(node_id=0, num_nodes=nodes)
+        eng = HashJoin(cfg, measurements=meas)
+        res = eng.join_arrays(r, s)              # compile warm-up
+        walls = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            res = eng.join_arrays(r, s)
+            walls.append((time.perf_counter() - t0) * 1e3)
+        return res, median(walls)
+
+    def instrumented_arm():
+        meas = Measurements(node_id=0, num_nodes=nodes)
+        meas.attach_tracer(nodes=nodes)
+        eng = HashJoin(cfg, measurements=meas)
+        statusz = StatuszServer(port=0,
+                                sections=measurements_sections(meas))
+        statusz.start()
+        url = f"http://127.0.0.1:{statusz.port}/statusz"
+        cp = None
+        try:
+            res = eng.join_arrays(r, s)          # compile warm-up
+            walls = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                res = eng.join_arrays(r, s)
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    json.loads(resp.read())
+                cp = critical_path_from_tracer(meas.tracer)
+                walls.append((time.perf_counter() - t0) * 1e3)
+            polls = statusz.requests_served
+        finally:
+            statusz.stop()
+        return res, median(walls), cp, polls
+
+    res_bare, bare_ms = bare_arm()
+    res_inst, inst_ms, cp, polls = instrumented_arm()
+    for arm, res in (("bare", res_bare), ("instrumented", res_inst)):
+        if not (res.ok and res.matches == n):
+            print(f"ERROR: {arm} arm missed the oracle: {res.matches} "
+                  f"!= {n}", file=sys.stderr)
+            return 3
+    if cp is None or cp.get("error"):
+        print(f"ERROR: no critical path reconstructed: "
+              f"{(cp or {}).get('error')}", file=sys.stderr)
+        return 3
+    overhead_pct = 100.0 * (inst_ms - bare_ms) / max(bare_ms, 1e-9)
+    mtps = (2 * n / 1e6) / (inst_ms / 1e3)
+    print(f"note: {n}x{n} join: bare {bare_ms:.1f} ms vs instrumented "
+          f"{inst_ms:.1f} ms (tracer + {polls} statusz polls + per-join "
+          f"critpath) -> overhead {overhead_pct:+.2f}%, path bound by "
+          f"rank {cp['bounding_rank']}, wait fraction "
+          f"{cp['wait_fraction']:.3f}", file=sys.stderr)
+    result = {
+        "metric": "critpath_overhead",
+        "value": round(mtps, 3),
+        "unit": "Mtuples/sec_instrumented",
+        "size": n,
+        "critpath_overhead_pct": round(max(0.0, overhead_pct), 3),
+        "wait_fraction": cp["wait_fraction"],
+        "bare_wall_ms": round(bare_ms, 2),
+        "instrumented_wall_ms": round(inst_ms, 2),
+        "statusz_polls": polls,
+        "critpath_path_ms": cp["path_ms"],
+        "critpath_barriers": len(cp.get("barriers", [])),
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if overhead_pct > 1.0:
+        print(f"ERROR: introspection overhead {overhead_pct:.2f}% exceeds "
+              "the 1% acceptance bar", file=sys.stderr)
+        return 3
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def _run_recovery_bench(check_baseline=None, size=1 << 18):
     """``--recovery-bench``: the elastic-recovery A/B — kill-1-of-8
     partition-level recovery versus the cold full restart it replaces.
@@ -1307,6 +1432,11 @@ def main():
                 sys.exit(2)
             sys.exit(_run_recovery_straggle_bench(check_baseline, factor))
         sys.exit(_run_recovery_bench(check_baseline))
+    if "--critpath-bench" in argv:
+        # critical-path attribution overhead A/B (observability/critpath
+        # + statusz): CPU-sized like --grid-bench — it gates the
+        # introspection plane's <1% overhead bar, not chip throughput
+        sys.exit(_run_critpath_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

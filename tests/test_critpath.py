@@ -1,0 +1,462 @@
+"""Critical-path attribution engine + statusz introspection plane.
+
+Covers the ISSUE 18 acceptance criteria directly:
+
+  * a synthetic skewed-rank fixture with a *known* bounding rank: the
+    path names that rank, carves the barrier skew into the straggle
+    class, and sums its fractions to 1;
+  * hedge claims shorten the path (measured basis when the straggler's
+    stream is visible, projected otherwise);
+  * missing ranks and torn spans degrade to a PARTIAL path with a
+    warning — never a crash;
+  * the driver prints ``[CRITPATH]`` and ``tools_critical_path.py``
+    reconstructs a path matching the measured JTOTAL within 5%;
+  * ``--serve --statusz PORT`` answers live JSON snapshots in-flight;
+  * a 2-rank run adopts ONE join-level trace id (rank 0 mints, peers
+    adopt via the lease-dir channel) and the cross-rank path carries a
+    real barrier.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+import tools_critical_path
+from tpu_radix_join.main import main
+from tpu_radix_join.observability.critpath import (compute_critical_path,
+                                                   critical_path_for_dir,
+                                                   format_summary,
+                                                   load_streams,
+                                                   render_report)
+from tpu_radix_join.observability.spans import SpanTracer
+from tpu_radix_join.observability.statusz import (StatuszServer,
+                                                  measurements_sections)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ----------------------------------------------------------- fixture helpers
+
+def _span(name, ts, dur, rank, **args):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "pid": rank, "tid": 0, "args": args}
+
+
+def _instant(name, ts, rank, **args):
+    return {"name": name, "ph": "i", "s": "p", "ts": float(ts),
+            "pid": rank, "tid": 0, "args": args}
+
+
+def _stream(rank, events, trace_id="t1", epoch_s=100.0):
+    return {"rank": rank, "trace_id": trace_id, "epoch_s": epoch_s,
+            "tags": {}, "events": events, "file": None}
+
+
+def _skewed_streams():
+    """3 ranks; rank 1 straggles through the JHIST barrier by a known
+    amount.  Barrier arrivals 30/90/32 ms -> median 32, skew 58; rank 1
+    then owns the tail (last to finish, at 160 ms)."""
+    return [
+        _stream(0, [_span("JTOTAL", 0, 100_000, 0),
+                    _span("JHIST", 0, 30_000, 0),
+                    _span("JPROC", 30_000, 60_000, 0)]),
+        _stream(1, [_span("JTOTAL", 0, 160_000, 1),
+                    _span("JHIST", 0, 90_000, 1),
+                    _span("JPROC", 90_000, 70_000, 1)]),
+        _stream(2, [_span("JTOTAL", 0, 100_000, 2),
+                    _span("JHIST", 0, 32_000, 2),
+                    _span("JPROC", 32_000, 60_000, 2)]),
+    ]
+
+
+# -------------------------------------------------- path over synthetic DAGs
+
+def test_single_rank_path_equals_jtotal():
+    """No peers, no barriers: the path IS the JTOTAL umbrella, exactly."""
+    res = compute_critical_path([_stream(0, [
+        _span("JTOTAL", 0, 50_000, 0),
+        _span("JPROC", 0, 50_000, 0)])])
+    assert "error" not in res
+    assert res["path_ms"] == 50.0 and res["jtotal_ms"] == 50.0
+    assert res["bounding_rank"] == 0 and not res["partial"]
+    assert res["barriers"] == [] and res["missing_ranks"] == []
+    assert res["fractions"]["compute"] == pytest.approx(1.0)
+    assert res["wait_fraction"] == pytest.approx(0.0)
+    assert res["top_phase"]["name"] == "JPROC"
+
+
+def test_skewed_rank_bounds_the_path():
+    """The known straggler bounds both the barrier and the whole path;
+    its barrier skew (90 - median 32 = 58 ms) lands in the straggle
+    class, attributed to rank 1."""
+    res = compute_critical_path(_skewed_streams())
+    assert "error" not in res and not res["partial"]
+    assert res["path_ms"] == 160.0 and res["jtotal_ms"] == 160.0
+    assert res["bounding_rank"] == 1
+
+    (b,) = res["barriers"]
+    assert b["name"] == "JHIST" and b["bounding_rank"] == 1
+    assert b["skew_ms"] == pytest.approx(58.0)
+    assert b["arrivals_ms"] == {"0": 30.0, "1": 90.0, "2": 32.0}
+
+    f = res["fractions"]
+    assert sum(f.values()) == pytest.approx(1.0, abs=1e-3)
+    assert f["straggle"] == pytest.approx(58.0 / 160.0, abs=1e-3)
+    assert res["wait_fraction"] == pytest.approx(58.0 / 160.0, abs=1e-3)
+    # the whole path runs through rank 1 (barrier segment + tail)
+    assert res["attribution_ms"] == {"1": 160.0}
+    # peers idled at the fence: (90-30) + (90-32) ms
+    assert res["peer_wait_ms"] == pytest.approx(118.0)
+    assert [s["via"] for s in res["segments"]] == ["JHIST#0", "finish"]
+
+
+def test_collective_and_gap_time_class_as_wait():
+    """Exchange spans and uncovered gaps on the owner's path both land
+    in collective_wait, not compute."""
+    res = compute_critical_path([_stream(0, [
+        _span("JTOTAL", 0, 100_000, 0),
+        _span("JPROC", 0, 40_000, 0),
+        _span("JMPI", 40_000, 30_000, 0),
+        # 30 ms tail gap: nothing covers [70, 100] -> wait
+    ])])
+    f = res["fractions"]
+    assert f["compute"] == pytest.approx(0.4, abs=1e-3)
+    assert f["collective_wait"] == pytest.approx(0.6, abs=1e-3)
+    assert res["phase_ms"]["JMPI"] == pytest.approx(30.0)
+
+
+def test_hedge_claim_shortens_path_measured_basis():
+    """Straggler stream visible: shortening = its late arrival minus the
+    claim that released the barrier (160 ms - 100 ms claim = 60 ms)."""
+    streams = _skewed_streams()
+    streams[0]["events"] += [
+        _instant("hedge_claim", 100_000, 0, partition=3, owner=0, epoch=2),
+        _instant("hedge", 95_000, 0, straggler=1),
+    ]
+    res = compute_critical_path(streams)
+    hedge = res["hedge"]
+    assert hedge["n_claims"] == 1 and hedge["straggler"] == 1
+    assert hedge["basis"] == "measured"
+    assert hedge["saved_ms_estimate"] == pytest.approx(60.0)
+    assert hedge["claims"][0]["partition"] == 3
+    line = format_summary(res)
+    assert "hedge_claims=1" in line and "saved_ms~60.0" in line
+    assert "hedge shortened the path by ~60.0 ms (measured)" \
+        in render_report(res)
+
+
+def test_hedge_projected_basis_and_missing_rank_partial():
+    """Straggler's own stream lost (died before save): the hole degrades
+    the path to PARTIAL with a warning, and the hedge shortening falls
+    back to rate extrapolation from the claim event's progress counters:
+    80 ms elapsed at 50% progress projects 160 ms, vs 100 ms actual."""
+    streams = [s for s in _skewed_streams() if s["rank"] != 1]
+    streams[0]["events"] += [
+        _instant("hedge_claim", 80_000, 0, partition=3, owner=0),
+        _instant("hedge", 80_000, 0, straggler=1,
+                 progress=50, outstanding=50),
+    ]
+    res = compute_critical_path(streams)
+    assert "error" not in res                    # degrade, never crash
+    assert res["missing_ranks"] == [1] and res["partial"]
+    assert any("missing" in w for w in res["warnings"])
+    hedge = res["hedge"]
+    assert hedge["basis"] == "projected"
+    assert hedge["saved_ms_estimate"] == pytest.approx(60.0)
+    assert "PARTIAL" in format_summary(res)
+
+
+def test_torn_spans_warn_but_never_crash():
+    streams = [_stream(0, [
+        _span("JTOTAL", 0, 40_000, 0, unclosed=True),
+        _span("JPROC", 0, 40_000, 0)])]
+    res = compute_critical_path(streams)
+    assert "error" not in res
+    assert res["partial"]
+    assert any("torn" in w for w in res["warnings"])
+    assert res["path_ms"] == 40.0
+
+
+def test_no_streams_degrades_to_error_dict():
+    res = compute_critical_path([])
+    assert res["error"] and res["partial"]
+    assert format_summary(res).startswith("unavailable")
+    assert "critical path unavailable" in render_report(res)
+
+
+def test_epoch_bumps_ride_the_path():
+    streams = _skewed_streams()
+    streams[2]["events"].append(_instant("rank_lost", 45_000, 2, epoch=3))
+    res = compute_critical_path(streams)
+    assert res["epoch_bumps"] == [
+        {"rank": 2, "event": "rank_lost", "t_ms": 45.0, "epoch": 3}]
+
+
+def test_window_us_slices_one_query_from_a_resident_stream():
+    """Two queries in one tracer stream: the window isolates the second
+    query's envelope (the per-query serve-mode path)."""
+    stream = _stream(0, [
+        _span("JTOTAL", 0, 10_000, 0),
+        _span("JTOTAL", 20_000, 30_000, 0),
+        _span("JPROC", 20_000, 30_000, 0)])
+    res = compute_critical_path([stream], window_us=(15_000, 60_000))
+    assert res["path_ms"] == 30.0
+    res_empty = compute_critical_path([stream], window_us=(11_000, 12_000))
+    assert "error" in res_empty and res_empty["partial"]
+
+
+# ---------------------------------------------------- trace-id cohort loading
+
+def test_load_streams_trace_cohorts(tmp_path):
+    """A directory holding two runs' exports: the largest trace cohort
+    wins; an explicit --trace-id overrides; duplicate ranks resolve to
+    the newest anchor."""
+    d = str(tmp_path)
+
+    def _export(rank, trace_id, epoch_s, fname):
+        tr = SpanTracer(rank=rank, trace_id=trace_id, epoch_s=epoch_s,
+                        mono_s=0.0)
+        tr.begin("JTOTAL")
+        tr.end("JTOTAL")
+        tr.save(d, filename=fname)
+
+    _export(0, "aaa", 100.0, "r0_a.spans.json")
+    _export(1, "aaa", 100.5, "r1_a.spans.json")
+    _export(0, "bbb", 200.0, "r0_b.spans.json")
+    _export(0, "aaa", 150.0, "r0_a2.spans.json")   # newer duplicate
+
+    streams, warnings = load_streams(d)
+    assert [s["rank"] for s in streams] == [0, 1]
+    assert all(s["trace_id"] == "aaa" for s in streams)
+    assert streams[0]["epoch_s"] == 150.0          # newest anchor won
+    assert any("other trace_ids" in w for w in warnings)
+    assert any("superseded" in w for w in warnings)
+
+    only_b, _ = load_streams(d, trace_id="bbb")
+    assert [s["trace_id"] for s in only_b] == ["bbb"]
+
+    none, warnings = load_streams(d, trace_id="zzz")
+    assert none == [] or not none
+    assert any("match" in w for w in warnings)
+
+
+# ------------------------------------------------- driver + CLI integration
+
+def test_driver_critpath_line_and_cli(tmp_path, capsys):
+    """A real CPU driver run prints [CRITPATH], stores the result on the
+    run metadata path, and tools_critical_path.py reconstructs a path
+    matching the measured JTOTAL within 5% (acceptance criterion)."""
+    d = str(tmp_path)
+    rc = main(["--tuples-per-node", "2048", "--nodes", "2",
+               "--timeline-dir", d])
+    assert rc == 0
+    out = capsys.readouterr().out
+    lines = [ln for ln in out.splitlines() if ln.startswith("[CRITPATH]")]
+    assert lines, out
+    assert "bound=rank0" in lines[0] and "path_ms=" in lines[0]
+
+    res = critical_path_for_dir(d)
+    assert "error" not in res
+    assert res["jtotal_ms"] and res["path_ms"] == pytest.approx(
+        res["jtotal_ms"], rel=0.05)
+
+    assert tools_critical_path.main([d]) == 0
+    report = capsys.readouterr().out
+    assert "critical path:" in report and "measured JTOTAL" in report
+
+    assert tools_critical_path.main([d, "--json"]) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["bounding_rank"] == 0
+
+    assert tools_critical_path.main([str(tmp_path / "nope")]) == 2
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert tools_critical_path.main([str(empty)]) == 1
+
+
+# ------------------------------------------------------------------- statusz
+
+def test_statusz_snapshot_and_http():
+    """In-process server: sections render, provider errors render in
+    place (never raise), unknown sections name the known ones, and the
+    HTTP plane serves the same payload as snapshot()."""
+    srv = StatuszServer(port=0, sections={
+        "ok": lambda: {"x": 1},
+        "boom": lambda: 1 / 0})
+    snap = srv.snapshot()
+    assert snap["ok"] == {"x": 1}
+    assert "ZeroDivisionError" in snap["boom"]["error"]
+    assert "t_epoch_s" in snap
+
+    with srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+            body = json.load(r)
+        assert body["ok"] == {"x": 1}
+        with urllib.request.urlopen(base + "/statusz/ok", timeout=10) as r:
+            one = json.load(r)
+        assert one["ok"] == {"x": 1} and "boom" not in one
+        with urllib.request.urlopen(base + "/statusz/nope",
+                                    timeout=10) as r:
+            unk = json.load(r)
+        assert "unknown section" in unk["nope"]["error"]
+        assert unk["nope"]["sections"] == ["boom", "ok"]
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert json.load(r)["ok"] is True
+    assert srv.requests_served == 4
+
+
+def test_measurements_sections_reflect_registry():
+    from tpu_radix_join.performance.measurements import Measurements
+    m = Measurements()
+    m.attach_tracer(trace_id="cafe")
+    m.incr("MTUPLES", 7)
+    m.tracer.begin("JPROC")
+    secs = measurements_sections(m)
+    phase = secs["phase"]()
+    assert phase["open_spans"] == {"JPROC": 1}
+    assert phase["context"].get("trace_id") == "cafe"
+    counters = secs["counters"]()
+    assert counters["counters"]["MTUPLES"] == 7
+    m.tracer.end("JPROC")
+
+
+def _wait_for_statusz_port(path, deadline_s=180.0):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    if line.startswith("[STATUSZ] serving"):
+                        return int(line.split(":")[2].split("/")[0])
+        time.sleep(0.2)
+    raise AssertionError("no [STATUSZ] line on stderr")
+
+
+def test_statusz_live_serve(tmp_path):
+    """--serve --statusz 0 answers JSON snapshots while the session is
+    in flight, and the critical_paths section fills per completed query
+    (acceptance criterion)."""
+    errf = str(tmp_path / "serve.err")
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+    env.update(
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+        PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    with open(errf, "w") as err:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_radix_join.main",
+             "--serve", "-", "--nodes", "2", "--tuples-per-node", "1024",
+             "--statusz", "0", "--timeline-dir", str(tmp_path / "tl")],
+            stdin=subprocess.PIPE, stdout=subprocess.PIPE, stderr=err,
+            text=True, cwd=REPO, env=env)
+    try:
+        port = _wait_for_statusz_port(errf)
+        base = f"http://127.0.0.1:{port}"
+        with urllib.request.urlopen(base + "/healthz", timeout=10) as r:
+            assert json.load(r)["ok"] is True
+        # a snapshot BEFORE any query: sections are wired, paths empty
+        with urllib.request.urlopen(base + "/statusz", timeout=10) as r:
+            body = json.load(r)
+        assert {"counters", "phase", "service", "hedge",
+                "critical_paths"} <= set(body)
+        assert body["critical_paths"] == []
+
+        proc.stdin.write(json.dumps(
+            {"query_id": "q0", "tuples_per_node": 1024, "seed": 7}) + "\n")
+        proc.stdin.flush()
+        outcome = json.loads(proc.stdout.readline())
+        assert outcome["query_id"] == "q0" and outcome["status"] == "ok"
+
+        # in-flight (session still resident): per-query path is served
+        with urllib.request.urlopen(base + "/statusz/critical_paths",
+                                    timeout=10) as r:
+            paths = json.load(r)["critical_paths"]
+        assert len(paths) == 1 and paths[0]["query_id"] == "q0"
+        assert paths[0]["path_ms"] > 0
+        with urllib.request.urlopen(base + "/statusz/counters",
+                                    timeout=10) as r:
+            counters = json.load(r)["counters"]
+        assert "JTOTAL" in counters["times_us"]
+    finally:
+        try:
+            proc.stdin.close()
+        except OSError:
+            pass
+        out_rest = proc.stdout.read()
+        rc = proc.wait(timeout=180)
+        proc.stdout.close()
+    with open(errf) as f:
+        err_text = f.read()
+    assert rc == 0, out_rest + err_text
+
+
+# ------------------------------------------------------- 2-rank integration
+
+def test_two_rank_trace_adoption_and_cross_rank_path(tmp_path):
+    """Two real jax.distributed CPU processes: rank 0 mints the join
+    trace id, rank 1 adopts it via the lease-dir channel (one id across
+    both span exports), and the reconstructed path spans both ranks with
+    a real cross-rank barrier."""
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    port = sock.getsockname()[1]
+    sock.close()
+    d = str(tmp_path)
+    procs = []
+    for rank in range(2):
+        env = {k: v for k, v in os.environ.items()
+               if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
+        env.update(
+            JAX_PLATFORMS="cpu",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(rank),
+            PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "tpu_radix_join.main",
+             "--tuples-per-node", "1024", "--nodes", "8", "--hosts", "2",
+             "--timeline-dir", d],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            env=env, text=True, cwd=REPO))
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        raise
+    joined = "\n---- rank boundary ----\n".join(outs)
+    assert all(p.returncode == 0 for p in procs), joined
+
+    tids = set()
+    for rank in range(2):
+        with open(os.path.join(d, f"{rank}.spans.json")) as f:
+            tids.add(json.load(f)["metadata"]["trace_id"])
+    assert len(tids) == 1 and None not in tids, joined   # satellite 1
+
+    res = critical_path_for_dir(d)
+    assert "error" not in res, res
+    assert res["ranks"] == [0, 1] and not res["missing_ranks"]
+    assert res["trace_id"] in tids
+    assert len(res["barriers"]) >= 1, res    # a real cross-rank edge
+    assert res["bounding_rank"] in (0, 1)
+    assert sum(res["fractions"].values()) == pytest.approx(1.0, abs=0.01)
+
+    cp = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools_critical_path.py"),
+         d, "--summary"],
+        capture_output=True, text=True, cwd=REPO)
+    assert cp.returncode == 0, cp.stdout + cp.stderr
+    assert cp.stdout.startswith("[CRITPATH]") and "barriers=" in cp.stdout

@@ -167,8 +167,15 @@ def emit_timeline(base_dir: str, out_path: str) -> int:
               f"expected rank(s) left no readable span file; the timeline "
               f"is PARTIAL", file=sys.stderr)
     if md.get("corrupt_files"):
-        print(f"WARNING: skipped unreadable span file(s): "
-              f"{md['corrupt_files']}", file=sys.stderr)
+        # name each skipped file *and why* — a torn write, a permissions
+        # problem, and a non-span JSON all want different operator action
+        reasons = {e["file"]: e["reason"]
+                   for e in md.get("corrupt_file_reasons", [])}
+        detail = "; ".join(
+            f"{f}: {reasons.get(f, 'unknown reason')}"
+            for f in md["corrupt_files"])
+        print(f"WARNING: skipped {len(md['corrupt_files'])} span "
+              f"file(s) — {detail}", file=sys.stderr)
     return 0
 
 

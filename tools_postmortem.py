@@ -130,11 +130,36 @@ def main(argv=None) -> int:
                          if row.get("drift_pct") is not None else "")
                 qid = (f" query={row['query_id']}"
                        if row.get("query_id") else "")
+                tid = (f" trace={row['trace_id']}"
+                       if row.get("trace_id") else "")
                 mep = (f" epoch={row['membership_epoch']}"
                        if row.get("membership_epoch") is not None else "")
                 print(f"  {row['path']}: {row['reason']} "
                       f"[{row['failure_class']}] rank={row['rank']} "
-                      f"strategy={row.get('strategy')}{drift}{qid}{mep}")
+                      f"strategy={row.get('strategy')}{drift}{qid}{tid}"
+                      f"{mep}")
+                # per-query critical-path breakdown: which rank's which
+                # phase bounded this bundle's join, and how much of it
+                # was waiting (rows without one cost nothing)
+                cp = row.get("critical_path")
+                if cp and not cp.get("error"):
+                    f = cp.get("fractions") or {}
+                    top = cp.get("top_phase") or {}
+                    print(f"    critical path: {cp.get('path_ms')}ms "
+                          f"bound=rank{cp.get('bounding_rank')} "
+                          f"compute={f.get('compute', 0) * 100:.0f}% "
+                          f"wait={f.get('collective_wait', 0) * 100:.0f}% "
+                          f"straggle={f.get('straggle', 0) * 100:.0f}%"
+                          + (f" top={top.get('name')}@"
+                             f"r{top.get('rank')}:{top.get('ms')}ms"
+                             if top else ""))
+                    hedge = cp.get("hedge") or {}
+                    if hedge.get("n_claims"):
+                        saved = hedge.get("saved_ms_estimate")
+                        print(f"    hedge: {hedge['n_claims']} claim(s)"
+                              + (f", shortened path ~{saved}ms "
+                                 f"({hedge.get('basis')})"
+                                 if saved is not None else ""))
         bad = sum(1 for r in summary["rows"] if "error" in r)
         return 1 if bad else 0
     rc = 0

@@ -240,6 +240,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode: default per-query latency budget "
                         "(requests may override with their own deadline_s; "
                         "expiry -> deadline_exceeded)")
+    p.add_argument("--statusz", type=int, default=None, metavar="PORT",
+                   help="serve mode: expose a read-only live-introspection "
+                        "HTTP endpoint on 127.0.0.1:PORT "
+                        "(observability/statusz.py): GET /statusz returns a "
+                        "JSON snapshot of the current phase + open spans, "
+                        "counter registry, SLO/breaker/queue state, lease "
+                        "board + membership epoch, straggler/hedge posture, "
+                        "and the last few per-query critical paths; "
+                        "/statusz/<section> returns one section, /healthz "
+                        "liveness; 0 = pick an ephemeral port (printed on "
+                        "stderr)")
     p.add_argument("--breaker-threshold", type=int, default=3,
                    help="serve mode: consecutive backend failures that trip "
                         "the circuit breaker onto the degraded CPU engine")
@@ -374,6 +385,56 @@ def _lease_dir(args):
             or tempfile.mkdtemp(prefix="tpu_rj_leases_"))
 
 
+def _trace_identity(args, rank):
+    """Join-level trace id shared by every rank of one distributed run.
+
+    Rank 0 mints the id and publishes it through the shared lease dir —
+    the only cross-rank side channel that exists before the mesh does;
+    peers adopt it by polling for the file (with a freshness fence so a
+    previous run's stale file is never adopted).  Every rank's span
+    export, ledger row, and forensics bundle then carries ONE
+    correlation key, which is what lets tools_critical_path.py group a
+    directory of span files into a single join.  A peer that never sees
+    the file falls back to minting locally with a warning — correlation
+    degrades, the run does not."""
+    import os
+    import tempfile
+    import time
+
+    from tpu_radix_join.observability.spans import _new_trace_id
+
+    lease_dir = _lease_dir(args)
+    path = os.path.join(lease_dir, "trace_id")
+    if rank == 0:
+        tid = _new_trace_id()
+        os.makedirs(lease_dir, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=lease_dir, prefix=".trace_id.")
+        with os.fdopen(fd, "w") as f:
+            f.write(tid)
+        os.replace(tmp, path)
+        return tid
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        try:
+            st = os.stat(path)
+            # freshness fence: only adopt a file written for THIS run
+            # (peers launch within the lease window of rank 0; anything
+            # older is a leftover from an earlier run in the same dir)
+            if time.time() - st.st_mtime <= 120.0:
+                with open(path) as f:
+                    tid = f.read().strip()
+                if tid:
+                    return tid
+        except OSError:
+            pass
+        time.sleep(0.05)
+    tid = _new_trace_id()
+    print(f"[OBS] rank {rank}: no shared trace_id under {lease_dir} "
+          f"after 10s; minted {tid} locally — cross-rank correlation "
+          "degraded", file=sys.stderr)
+    return tid
+
+
 def _ledger_dir(args):
     """The cross-run ledger location: explicit flag, then the environment
     — None means this run keeps no ledger (the pre-ledger default)."""
@@ -495,9 +556,16 @@ def _run_grid(args, inner, outer, expected, meas, plan=None) -> int:
             print(f"[PERF] stored {path}")
         return 1
     meas.stop("JTOTAL")
+    cp = None
+    if meas.tracer is not None:
+        from tpu_radix_join.observability.critpath import (
+            critical_path_from_tracer, format_summary)
+        cp = critical_path_from_tracer(meas.tracer)
+        meas.meta["critical_path"] = cp
+        print(f"[CRITPATH] {format_summary(cp)}")
     # plan-vs-actual: the grid engine's measured JTOTAL against the cost
     # model's prediction for the chunked strategy (planner/audit.py)
-    audit = audit_plan(plan, meas, times0=times0)
+    audit = audit_plan(plan, meas, times0=times0, critical_path=cp)
     if audit is not None:
         print(f"[PLAN] actual_ms={audit['actual_ms']:.1f} "
               f"predicted_ms={audit['predicted_ms']:.1f} "
@@ -575,8 +643,40 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         else:
             sampler.extra = session._heartbeat_extra
 
+    statusz = None
+    if args.statusz is not None:
+        # live introspection plane: read-only JSON over loopback, priced
+        # per request (no background sampling thread) — polling it costs
+        # the poller, not the join
+        from tpu_radix_join.observability.statusz import (
+            StatuszServer, measurements_sections)
+        from tpu_radix_join.performance.measurements import (HEDGED,
+                                                             HEDGEWIN,
+                                                             SPECWASTE)
+        sections = dict(measurements_sections(meas))
+        sections["service"] = session._heartbeat_extra
+        if membership is not None:
+            sections["leases"] = membership.board.sampler_extra(
+                epoch_of=membership.epoch_of)
+        sections["hedge"] = (lambda: {
+            "mode": session.hedge,
+            "threshold": session.hedge_threshold,
+            "elastic_grow": session.elastic_grow,
+            "hedged": int(meas.counters.get(HEDGED, 0)),
+            "wins": int(meas.counters.get(HEDGEWIN, 0)),
+            "wasted": int(meas.counters.get(SPECWASTE, 0))})
+        sections["critical_paths"] = (
+            lambda: list(session.recent_critical_paths))
+        statusz = StatuszServer(port=args.statusz, sections=sections)
+        statusz.start()
+        print(f"[STATUSZ] serving http://127.0.0.1:{statusz.port}"
+              "/statusz", file=sys.stderr)
+
     if args.serve == "-":
-        lines = sys.stdin.read().splitlines()
+        # stream, don't slurp: a resident session answers requests as
+        # they arrive on the pipe (an operator can hold stdin open and
+        # poll --statusz between queries); EOF still ends the session
+        lines = iter(sys.stdin)
     else:
         with open(args.serve) as f:
             lines = f.read().splitlines()
@@ -625,6 +725,8 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         # executed-and-failed queries (or unparseable requests) fail the run
         return 1 if (errors or summary.get("queries_failed", 0)) else 0
     finally:
+        if statusz is not None:
+            statusz.stop()
         session.close()
 
 
@@ -866,7 +968,12 @@ def main(argv=None) -> int:
     tracer = None
     if args.timeline_dir:
         os.makedirs(args.timeline_dir, exist_ok=True)
-        tracer = meas.attach_tracer(nodes=nodes)
+        # distributed runs share ONE join-level trace id (rank 0 mints,
+        # peers adopt through the lease dir) so the exported span files
+        # correlate as a single join; solo runs mint locally
+        trace_id = (_trace_identity(args, jax.process_index())
+                    if jax.process_count() > 1 else None)
+        tracer = meas.attach_tracer(trace_id=trace_id, nodes=nodes)
     sampler = None
     if args.metrics_interval:
         mdir = args.timeline_dir or args.output_dir
@@ -1048,7 +1155,30 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
                 plan_static = _plan_static_payload(profile, workload,
                                                    plan, meas)
                 if args.plan == "explain":
-                    print(explain_table(costs, plan, static=plan_static))
+                    cp_col = None
+                    if args.timeline_dir:
+                        # measured critical path from the span exports a
+                        # prior run left under --timeline-dir: the table
+                        # prices the winning strategy against the rank
+                        # that actually bounded the wall clock, not the
+                        # local mean
+                        from tpu_radix_join.observability.critpath import \
+                            critical_path_for_dir
+                        cp = critical_path_for_dir(args.timeline_dir)
+                        if not cp.get("error"):
+                            # compile wall comes off the measured bound
+                            # (audit_plan's exclude-from-running twin):
+                            # the table prices steady-state joins
+                            jc = float((cp.get("phase_ms") or {})
+                                       .get("JCOMPILE", 0.0))
+                            cp_col = {
+                                "strategy": plan.strategy,
+                                "bound_ms": max(
+                                    0.0, cp.get("path_ms", 0.0) - jc),
+                                "bound_rank": cp.get("bounding_rank"),
+                                "wait_fraction": cp.get("wait_fraction")}
+                    print(explain_table(costs, plan, static=plan_static,
+                                        critpath=cp_col))
                     # constants half of explain: where each profile
                     # constant came from (fit provenance vs committed
                     # citation) and which ones the ledger's accumulated
@@ -1163,7 +1293,9 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     # becomes a classified backend_unavailable exit, not a silent stall
     from tpu_radix_join.observability.watchdog import Watchdog, engine_killer
     from tpu_radix_join.planner.audit import (actuals_for_explain,
-                                              audit_plan, phase_snapshot)
+                                              audit_plan,
+                                              critpath_for_explain,
+                                              phase_snapshot)
 
     wd_ctx = (Watchdog(meas, timeout_s=args.watchdog_timeout,
                        kill=engine_killer(engine),
@@ -1230,7 +1362,22 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
     # the loop on the PR 2 cost model — measured JTOTAL vs predicted_ms,
     # PLANDRIFT gauge for the regress gate, and the explain table grows
     # its actuals column for the strategy that actually ran
-    audit = audit_plan(plan, meas, repeats=args.repeat, times0=times0)
+    # critical-path attribution (observability/critpath.py): reconstruct
+    # the path over this rank's live tracer stream (the cross-rank file
+    # merge is tools_critical_path.py's post-run job), stamp it into the
+    # registry meta so bundles and the ledger carry it, print the
+    # [CRITPATH] line, and re-price the plan audit against the measured
+    # bounding rank instead of the local mean
+    cp = None
+    if meas.tracer is not None:
+        from tpu_radix_join.observability.critpath import (
+            critical_path_from_tracer, format_summary)
+        cp = critical_path_from_tracer(meas.tracer)
+        meas.meta["critical_path"] = cp
+        if jax.process_index() == 0:
+            print(f"[CRITPATH] {format_summary(cp)}")
+    audit = audit_plan(plan, meas, repeats=args.repeat, times0=times0,
+                       critical_path=cp)
     if audit is not None and jax.process_index() == 0:
         print(f"[PLAN] actual_ms={audit['actual_ms']:.1f} "
               f"predicted_ms={audit['predicted_ms']:.1f} "
@@ -1238,7 +1385,8 @@ def _run_driver(args, cfg, meas, distributed, nodes, membership=None) -> int:
         if plan_costs is not None and explain_tbl is not None:
             print(explain_tbl(plan_costs, plan,
                               actuals=actuals_for_explain(audit),
-                              static=plan_static))
+                              static=plan_static,
+                              critpath=critpath_for_explain(audit)))
     # per-rank failure class rides the registry meta into the rank-0
     # aggregate report (performance.print_results): a multi-rank run where
     # one rank degraded must say so in the summary, not only in that

@@ -33,10 +33,25 @@ The always-on black-box layer (ISSUE 8) adds three more:
     the engine's cancel hook, dumping stacks + ring on the way;
   * :mod:`postmortem` — self-contained forensics bundles on any terminal
     failure, rendered/merged by ``tools_postmortem.py``.
+
+The attribution layer (ISSUE 18) adds two more:
+
+  * :mod:`critpath` — cross-rank critical-path reconstruction over
+    exported span streams: which rank's which phase bounded the wall
+    clock, decomposed into compute / collective-wait / straggle, with
+    hedge-claim shortening estimates (``[CRITPATH]`` driver line,
+    ``tools_critical_path.py``, the ``--plan explain`` measured column);
+  * :mod:`statusz` — read-only live JSON introspection endpoint for the
+    resident service (``--serve --statusz PORT``).
 """
 
 from tpu_radix_join.observability.compilemon import (install_compile_monitor,
                                                      uninstall_compile_monitor)
+from tpu_radix_join.observability.critpath import (compute_critical_path,
+                                                   critical_path_for_dir,
+                                                   critical_path_from_tracer,
+                                                   format_summary,
+                                                   render_report)
 from tpu_radix_join.observability.flightrec import (FlightRecorder,
                                                     dump_all_stacks)
 from tpu_radix_join.observability.ledger import (Ledger, bench_payload,
@@ -55,6 +70,8 @@ from tpu_radix_join.observability.regress import (check_files, check_result,
                                                   format_table,
                                                   parse_tag_thresholds)
 from tpu_radix_join.observability.spans import SpanTracer
+from tpu_radix_join.observability.statusz import (StatuszServer,
+                                                  measurements_sections)
 from tpu_radix_join.observability.timeline import (find_span_files,
                                                    merge_timeline)
 from tpu_radix_join.observability.watchdog import (HangDetected, Watchdog,
@@ -62,12 +79,14 @@ from tpu_radix_join.observability.watchdog import (HangDetected, Watchdog,
 
 __all__ = [
     "FlightRecorder", "HangDetected", "Ledger", "MetricsSampler",
-    "SpanTracer", "Watchdog", "bench_payload", "build_bundle",
-    "check_files", "check_result", "compare_tags", "default_ledger_dir",
-    "dump_all_stacks", "engine_killer", "extract_tags", "find_span_files",
+    "SpanTracer", "StatuszServer", "Watchdog", "bench_payload",
+    "build_bundle", "check_files", "check_result", "compare_tags",
+    "compute_critical_path", "critical_path_for_dir",
+    "critical_path_from_tracer", "default_ledger_dir", "dump_all_stacks",
+    "engine_killer", "extract_tags", "find_span_files", "format_summary",
     "format_table", "ingest_artifacts", "install_compile_monitor",
     "list_bundles", "load_bundle", "load_rows", "load_samples",
-    "merge_bundles", "merge_timeline", "parse_tag_thresholds",
-    "render_bundle", "run_payload", "uninstall_compile_monitor",
-    "write_bundle",
+    "measurements_sections", "merge_bundles", "merge_timeline",
+    "parse_tag_thresholds", "render_bundle", "render_report",
+    "run_payload", "uninstall_compile_monitor", "write_bundle",
 ]

@@ -139,11 +139,16 @@ def build_bundle(measurements=None, reason: str = "failure",
     if m is not None:
         ring = m.flightrec.snapshot()
         qid = ring["context"].get("query_id")
+        # trace identity joins this bundle to span files / ledger rows /
+        # merged timelines of the same join across every store
+        tid = ring["context"].get("trace_id") or meta.get("trace_id")
         bundle.update({
             "rank": m.node_id,
             "host": meta.get("host"),
             "nodes": m.num_nodes,
             "query_id": qid,
+            "trace_id": tid,
+            "critical_path": meta.get("critical_path"),
             "ring": ring,
             "counters": dict(m.counters),
             "times_us": {k: round(v, 1) for k, v in m.times_us.items()},
@@ -222,6 +227,12 @@ def render_bundle(bundle: dict, ring_tail: int = 20,
         f"nodes: {bundle.get('nodes')}")
     if bundle.get("query_id"):
         add(f"query_id: {bundle['query_id']}")
+    if bundle.get("trace_id"):
+        add(f"trace_id: {bundle['trace_id']}")
+    cp = bundle.get("critical_path")
+    if cp and not cp.get("error"):
+        from tpu_radix_join.observability.critpath import format_summary
+        add(f"critical path: {format_summary(cp)}")
     env = bundle.get("env") or {}
     add("env: " + " ".join(f"{k}={v}" for k, v in sorted(env.items())
                            if v is not None))
@@ -310,11 +321,20 @@ def merge_bundles(paths) -> dict:
             t_min = t if t_min is None else min(t_min, t)
             t_max = t if t_max is None else max(t_max, t)
         pva = b.get("plan_vs_actual") or {}
-        # membership epoch: the exception's own stamp first (RankLost /
-        # StaleEpoch carry it in extra), else the registry's MEPOCH gauge
-        extra = b.get("extra") or {}
-        counters = b.get("counters") or {}
-        mepoch = extra.get("membership_epoch", counters.get("MEPOCH"))
+        # membership epoch: every epoch bump / hedge stamps
+        # membership_epoch into the flight-recorder context, so the ring
+        # carries it directly — the live context first, else the newest
+        # stamped record, else the exception's own stamp in extra.  No
+        # more inferring from a neighboring record's MEPOCH gauge.
+        ring = b.get("ring") or {}
+        mepoch = (ring.get("context") or {}).get("membership_epoch")
+        if mepoch is None:
+            for rec in reversed(ring.get("records") or []):
+                if "membership_epoch" in rec:
+                    mepoch = rec["membership_epoch"]
+                    break
+        if mepoch is None:
+            mepoch = (b.get("extra") or {}).get("membership_epoch")
         epochs[str(mepoch)] = epochs.get(str(mepoch), 0) + 1
         # the recovery timeline: membership + recovery events from every
         # bundle's event tail, aligned on the cross-process wall clock —
@@ -329,6 +349,8 @@ def merge_bundles(paths) -> dict:
         rows.append({"path": p, "reason": b.get("reason"),
                      "failure_class": fc, "rank": rank,
                      "query_id": b.get("query_id"),
+                     "trace_id": b.get("trace_id"),
+                     "critical_path": b.get("critical_path"),
                      "membership_epoch": mepoch,
                      "strategy": pva.get("strategy")
                      or (b.get("plan") or {}).get("strategy"),

@@ -129,7 +129,16 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             "lint_findings", "stale_baseline",
                             # graftcheck (tools_jaxpr_audit.py --json): live
                             # IR-level findings gate the same way
-                            "jaxpr_findings")
+                            "jaxpr_findings",
+                            # critical-path attribution (--critpath-bench
+                            # and observability/critpath.py): instrumented-
+                            # vs-bare overhead must stay a rounding error
+                            # (the <1% acceptance bar), and a growing
+                            # wait fraction means more of the bounding
+                            # rank's path is collective-wait/straggle
+                            # rather than work — a fleet-balance
+                            # regression even when JTOTAL holds
+                            "critpath_overhead_pct", "wait_fraction")
 # Exact-name lower-is-better pins for the Measurements counter/timer
 # vocabulary (performance/measurements.py).  Historically these rode the
 # "unmatched tags default to cost" rule; the counter-tag lint rule

@@ -36,15 +36,20 @@ def find_span_files(timeline_dir: str) -> List[str]:
                   recursive=True))
 
 
-def _load(path: str) -> Optional[dict]:
+def _load(path: str) -> Tuple[Optional[dict], Optional[str]]:
+    """Read one span file; returns (doc, None) or (None, skip-reason).
+    The reason travels into the merge metadata and warnings so a partial
+    merge names *why* each file was dropped, not just that it was."""
     try:
         with open(path) as f:
             doc = json.load(f)
-    except (OSError, ValueError):
-        return None
+    except OSError as e:
+        return None, f"unreadable ({e.__class__.__name__}: {e})"
+    except ValueError as e:
+        return None, f"malformed JSON (torn write? {e})"
     if not isinstance(doc, dict) or "traceEvents" not in doc:
-        return None
-    return doc
+        return None, "not a span file (no traceEvents object)"
+    return doc, None
 
 
 def _device_track_events(rank: int, summary: dict, start_us: float,
@@ -96,12 +101,15 @@ def merge_timeline(timeline_dir: str, out_path: Optional[str] = None,
     """
     docs: List[Tuple[str, dict]] = []
     corrupt: List[str] = []
+    corrupt_reasons: List[dict] = []
     for path in find_span_files(timeline_dir):
-        doc = _load(path)
+        doc, reason = _load(path)
         if doc is not None:
             docs.append((path, doc))
         else:
             corrupt.append(os.path.basename(path))
+            corrupt_reasons.append({"file": os.path.basename(path),
+                                    "reason": reason})
     if not docs:
         return None
 
@@ -176,6 +184,7 @@ def merge_timeline(timeline_dir: str, out_path: Optional[str] = None,
             "expected_ranks": expected or len(ranks),
             "missing_ranks": missing,
             "corrupt_files": corrupt,
+            "corrupt_file_reasons": corrupt_reasons,
             "partial": bool(missing or corrupt),
         },
     }

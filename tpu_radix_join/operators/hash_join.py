@@ -2449,12 +2449,19 @@ class HashJoin:
         (hedge-never-double-counts) and scores as SPECWASTE."""
         m = self.measurements
         strag_nodes = self._straggler_nodes(exc)
+        epoch = max(self._membership_epoch(), int(exc.epoch))
         if m is not None:
+            # a hedge does NOT bump the epoch, so no membership-layer
+            # stamp precedes these records — stamp the fence epoch here
+            # so the HEDGED tick (and the later HEDGEWIN/SPECWASTE
+            # scoring) carry it instead of forensics inferring it from
+            # neighboring ring records
+            m.flightrec.set_context(membership_epoch=epoch)
             m.incr(HEDGED)
             m.event("hedge", straggler=int(exc.rank), nodes=strag_nodes,
-                    progress=int(exc.progress), median=float(exc.median),
+                    epoch=epoch, progress=int(exc.progress),
+                    median=float(exc.median),
                     outstanding=int(exc.outstanding))
-        epoch = max(self._membership_epoch(), int(exc.epoch))
         return self._recover_join(
             r, s, exc, repeats, lost_nodes=strag_nodes, epoch=epoch,
             span_name="hedge", hedge_exc=exc,

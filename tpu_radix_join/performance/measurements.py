@@ -195,16 +195,25 @@ class Measurements:
                                         mono_s=self._mono0)
 
     # ------------------------------------------------------------ span tracer
-    def attach_tracer(self, tracer=None, **tags):
+    def attach_tracer(self, tracer=None, trace_id=None, **tags):
         """Attach (or build) an observability.SpanTracer sharing this
         registry's clock anchors: every ``start``/``stop`` pair then mirrors
         into a timeline span and every :meth:`event` into an instant event.
-        Returns the tracer."""
+        Returns the tracer.
+
+        ``trace_id`` is the join-level trace identity (rank 0 mints one,
+        peers adopt it over the lease-dir channel) — it lands in the span
+        file metadata, ``meta["trace_id"]``, and the flight-recorder
+        context, so span files, ledger rows, and forensics bundles all
+        join on the same key."""
         if tracer is None:
             from tpu_radix_join.observability.spans import SpanTracer
-            tracer = SpanTracer(rank=self.node_id, tags=tags,
+            tracer = SpanTracer(rank=self.node_id, trace_id=trace_id,
+                                tags=tags,
                                 epoch_s=self.meta["epoch_s"],
                                 mono_s=self._mono0)
+        self.meta["trace_id"] = tracer.trace_id
+        self.flightrec.set_context(trace_id=tracer.trace_id)
         self._tracer = tracer
         return tracer
 

@@ -48,14 +48,21 @@ def phase_snapshot(measurements) -> Dict[str, float]:
 
 
 def audit_plan(plan, measurements, repeats: int = 1,
-               times0: Optional[Dict[str, float]] = None) -> Optional[dict]:
+               times0: Optional[Dict[str, float]] = None,
+               critical_path: Optional[dict] = None) -> Optional[dict]:
     """Record the plan-vs-actual table for the join that just ran.
 
     ``plan`` is a JoinPlan or its dict; ``repeats`` divides the measured
     JTOTAL down to the per-join granularity predicted_ms speaks.
     Returns the table (also stamped into ``meta["plan_vs_actual"]``), or
     None when there is nothing to audit (no JTOTAL recorded — the join
-    died before the pipeline started)."""
+    died before the pipeline started).
+
+    ``critical_path`` (an observability/critpath.py result) re-prices the
+    drift against the *measured bounding rank* instead of the local mean:
+    the PLANDRIFT gauge the fitter calibrates on then tracks the path
+    that actually bounds wall-clock, and the table carries the
+    bound-rank terms under ``"critical_path"``."""
     m = measurements
     if m is None or plan is None:
         return None
@@ -93,12 +100,37 @@ def audit_plan(plan, measurements, repeats: int = 1,
         "terms": terms,
         "measured_ms": {k: round(v / reps, 3) for k, v in delta_ms.items()},
     }
+    gauge_drift = drift_pct
+    if critical_path and not critical_path.get("error"):
+        bound_ms = critical_path.get("path_ms")
+        if bound_ms:
+            # the cost model predicts steady-state joins; the measured
+            # path keeps compile wall (the timeline is honest about it),
+            # so the on-path JCOMPILE share comes off before pricing —
+            # the same exclude-from-running discipline times_us applies
+            compile_ms = float((critical_path.get("phase_ms") or {})
+                               .get("JCOMPILE", 0.0))
+            bound_ms = round(max(0.0, float(bound_ms) - compile_ms)
+                             / reps, 3)
+            bound_drift = (round(100.0 * abs(bound_ms - predicted_ms)
+                                 / predicted_ms, 2)
+                           if predicted_ms > 0 else None)
+            table["critical_path"] = {
+                "bound_ms": bound_ms,
+                "bound_rank": critical_path.get("bounding_rank"),
+                "wait_fraction": critical_path.get("wait_fraction"),
+                "drift_pct": bound_drift,
+            }
+            if bound_drift is not None:
+                # price the gauge against the measured bounding rank,
+                # not the local mean — the path that matters
+                gauge_drift = bound_drift
     m.meta["plan_vs_actual"] = table
-    if drift_pct is not None:
+    if gauge_drift is not None:
         # gauge assignment (each audited join overwrites): the regress
         # gate reads the last join's drift, not an accumulated sum
-        m.counters[PLANDRIFT] = int(round(drift_pct))
-        m.flightrec.record("gauge", PLANDRIFT, drift_pct=drift_pct,
+        m.counters[PLANDRIFT] = int(round(gauge_drift))
+        m.flightrec.record("gauge", PLANDRIFT, drift_pct=gauge_drift,
                            strategy=table["strategy"])
     m.event("plan_drift", strategy=table["strategy"],
             predicted_ms=table["predicted_ms"],
@@ -114,3 +146,17 @@ def actuals_for_explain(table: Optional[dict]) -> Optional[dict]:
     return {"strategy": table.get("strategy"),
             "actual_ms": table.get("actual_ms"),
             "drift_pct": table.get("drift_pct")}
+
+
+def critpath_for_explain(table: Optional[dict]) -> Optional[dict]:
+    """Shape an audit table's bound-rank terms for explain_table's
+    measured-critical-path column: {strategy, bound_ms, bound_rank,
+    wait_fraction}.  None-safe passthrough (None when the run had no
+    timeline to reconstruct a path from)."""
+    if not table or not table.get("critical_path"):
+        return None
+    cp = table["critical_path"]
+    return {"strategy": table.get("strategy"),
+            "bound_ms": cp.get("bound_ms"),
+            "bound_rank": cp.get("bound_rank"),
+            "wait_fraction": cp.get("wait_fraction")}

@@ -258,7 +258,8 @@ def _narrow(w: Workload) -> bool:
 def explain_table(costs: List[StrategyCost],
                   chosen: Optional[JoinPlan] = None,
                   actuals: Optional[dict] = None,
-                  static: Optional[dict] = None) -> str:
+                  static: Optional[dict] = None,
+                  critpath: Optional[dict] = None) -> str:
     """Human-readable per-strategy predicted-cost table (the ``--plan
     explain`` payload).  Terms are columns so a reader can line each up
     against the measured phase columns in a chip perf artifact.
@@ -270,7 +271,11 @@ def explain_table(costs: List[StrategyCost],
     analysis/jaxpr/crossval.py ``static_for_explain``) adds the
     ``STATIC-DRIFT`` column: jaxpr-derived exchange bytes/tuple vs the
     cost model's ``bytes_per_tuple``, filled on the chosen row — an
-    execution-free grounding signal next to the runtime drift."""
+    execution-free grounding signal next to the runtime drift.
+    ``critpath`` (planner/audit.py ``critpath_for_explain``) adds the
+    ``critical_path`` column: the *measured bounding rank's* path length
+    — what predicted_ms should be priced against on a skewed mesh, where
+    the mean flatters the plan."""
     term_keys: List[str] = []
     for c in costs:
         for k in c.terms:
@@ -278,6 +283,7 @@ def explain_table(costs: List[StrategyCost],
                 term_keys.append(k)
     header = (["strategy", "feasible", "predicted_ms"]
               + (["actual_ms", "drift%"] if actuals else [])
+              + (["critical_path"] if critpath else [])
               + (["STATIC-DRIFT"] if static else [])
               + [f"{k}_ms" for k in term_keys] + ["note"])
     rows = []
@@ -292,6 +298,13 @@ def explain_table(costs: List[StrategyCost],
                              f"{d:.1f}" if d is not None else "-"]
             else:
                 act_cells = ["", ""]
+        cp_cells = []
+        if critpath:
+            b = critpath.get("bound_ms")
+            if c.strategy == critpath.get("strategy") and b is not None:
+                cp_cells = [f"{b:.1f}@r{critpath.get('bound_rank')}"]
+            else:
+                cp_cells = [""]
         static_cells = []
         if static:
             sd = static.get("drift_pct")
@@ -301,6 +314,7 @@ def explain_table(costs: List[StrategyCost],
                      "yes" if c.feasible else "NO",
                      f"{c.cost_ms:.1f}" if c.feasible else "-"]
                     + act_cells
+                    + cp_cells
                     + static_cells
                     + [f"{c.terms[k]:.1f}" if k in c.terms else ""
                        for k in term_keys]
@@ -328,6 +342,14 @@ def explain_table(costs: List[StrategyCost],
                    "xla": "(lax.sort emitter)"}.get(
                        chosen.sort_impl,
                        "(runtime auto-select per sort site)"))
+    if critpath and critpath.get("bound_ms") is not None:
+        wf = critpath.get("wait_fraction")
+        lines.append(
+            f"critical path: {critpath['bound_ms']:.1f} ms bound by "
+            f"rank {critpath.get('bound_rank')}"
+            + (f" (wait fraction {wf * 100:.1f}%)" if wf is not None
+               else "")
+            + " — plan terms priced against the bounding rank")
     if static:
         lines.append(
             f"static: jaxpr {static.get('entry', '?')} ships "

@@ -396,6 +396,11 @@ class MembershipView:
         self.epoch += 1
         m = self.measurements
         if m is not None:
+            # context first: the MEPOCH/RANKLOST records below — and every
+            # later HEDGED/HEDGEWIN/RANKJOIN tick — must carry the epoch
+            # they happened under, not leave forensics to infer it from
+            # neighboring records
+            m.flightrec.set_context(membership_epoch=self.epoch)
             m.incr(MEPOCH)
             m.incr(RANKLOST, len(fresh))
             m.event("rank_lost", ranks=fresh, epoch=self.epoch, cause=cause,
@@ -416,6 +421,7 @@ class MembershipView:
         self.epoch += 1
         m = self.measurements
         if m is not None:
+            m.flightrec.set_context(membership_epoch=self.epoch)
             m.incr(MEPOCH)
             m.incr(RANKJOIN, len(fresh))
             m.event("rank_join", ranks=fresh, epoch=self.epoch, cause=cause,

@@ -208,6 +208,11 @@ class JoinSession:
         self._sampler = None            # attached heartbeat, owned if set
         self._closed = False
         self.outcomes: List[QueryOutcome] = []
+        #: last N per-query critical paths (observability/critpath.py),
+        #: window-sliced from the attached tracer around each executed
+        #: query — the ``/statusz`` critical_paths section reads this
+        self.recent_critical_paths: "collections.deque" = \
+            collections.deque(maxlen=8)
 
     # ----------------------------------------------------------- admission
     def submit(self, request: QueryRequest) -> None:
@@ -328,6 +333,8 @@ class JoinSession:
         primary = self.breaker.allow_primary()
         probing = primary and self.breaker.state == HALF_OPEN
         engine = self.engine if primary else self._degraded_engine()
+        tracer = m.tracer if m is not None else None
+        win0_us = tracer.now_us() if tracer is not None else None
         t0 = time.perf_counter()
         jhist0 = m.times_us.get(JHIST, 0.0) if m is not None else 0.0
         nc0 = m.counters.get(NCOMPILE, 0) if m is not None else 0
@@ -459,12 +466,28 @@ class JoinSession:
                         failure_class=None if cls == OK else cls,
                         degraded=not primary)
         self.outcomes.append(out)
+        if tracer is not None:
+            # per-query critical path: slice this query's window out of
+            # the resident tracer stream so each query gets its own
+            # attribution (read by /statusz; a path failure is evidence,
+            # never a new failure for the query)
+            try:
+                from tpu_radix_join.observability.critpath import (
+                    critical_path_from_tracer)
+                cp = critical_path_from_tracer(
+                    tracer, window_us=(win0_us, tracer.now_us()))
+                cp["query_id"] = request.query_id
+                self.recent_critical_paths.append(cp)
+            except Exception as e:   # noqa: BLE001 — isolation boundary
+                m.event("critpath_error", error=repr(e)[:200])
         if self.ledger is not None:
             # one ledger row per executed query; a ledger write failure is
             # an event, never a new failure for the query
             try:
                 self.ledger.append("query", {
                     "query_id": request.query_id, "tenant": request.tenant,
+                    "trace_id": (m.meta.get("trace_id")
+                                 if m is not None else None),
                     "status": status, "failure_class": cls,
                     "latency_ms": round(latency_ms, 3),
                     "warm": warm, "engine": out.engine,
