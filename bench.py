@@ -1327,6 +1327,165 @@ def _run_recovery_grow_bench(check_baseline=None, size=1 << 19):
     return 0
 
 
+def _run_fleet_bench(check_baseline=None, workers=4, tpn=1 << 10):
+    """``--fleet-bench``: the crash-only fleet failover A/B — kill-1-of-4
+    mid-query failover versus the cold supervisor restart it replaces.
+
+    The **failover arm** boots a 4-worker supervised fleet
+    (service/fleet.py), compile-warms every slot through its ring tenant,
+    then arms ``fleet.worker_kill``: the timed query's routed worker is
+    SIGKILLed with the request on its pipe, and the wall runs until a
+    *survivor* serves the journal-replayed attempt.  The **cold arm** is
+    what a non-supervised serve deployment pays for the same death: a
+    fresh supervisor restarted over a journal holding that unacknowledged
+    intent, with the wall covering worker boot + replay + cold compile.
+
+    Exit 3 unless both arms are oracle-exact, the failover attempt count
+    proves a real mid-query death (attempts >= 2), both drains report the
+    journal fully acknowledged with ``double_exec == 0`` (the
+    exactly-once invariant), and failover beats the cold restart.  The
+    BENCH headline ``value`` is the wall ratio (cold restart over
+    failover, higher is better); ``failover_ms`` / ``cold_restart_ms`` /
+    ``failover`` / ``replayn`` / ``jdepth`` / ``wincarn`` /
+    ``worker_restarts`` / ``double_exec`` gate lower-is-better under
+    tools_check_regress.py (``double_exec`` pins to zero: any growth from
+    a zero base is an infinite delta)."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import tempfile
+
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import (FAILOVER, JDEPTH,
+                                                         REPLAYN, WINCARN)
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.service.fleet import FleetSupervisor, route_tenant
+    from tpu_radix_join.service.journal import QueryJournal
+
+    nodes = 1                   # single-device workers: boot cost is the
+    expect = tpn * nodes        # jax import + one compile, not the mesh
+    worker_args = ["--nodes", str(nodes), "--verify", "check"]
+
+    def req(qid, tenant):
+        return {"query_id": qid, "tenant": tenant,
+                "tuples_per_node": tpn, "seed": 7}
+
+    tmp = tempfile.mkdtemp(prefix="fleet_bench_")
+
+    # ---- failover arm: warm fleet, SIGKILL the routed worker mid-query
+    m = Measurements()
+    sup = FleetSupervisor(workers, worker_args,
+                          os.path.join(tmp, "failover"),
+                          measurements=m, lease_s=1.0)
+    try:
+        sup.start()
+        # one tenant per ring slot so every worker compile-warms before
+        # the timed kill — the failover lands on a warm survivor, which
+        # is the steady-state a supervised fleet actually runs in
+        slots = list(range(workers))
+        tenant_for = {}
+        i = 0
+        while len(tenant_for) < workers and i < 10000:
+            t = f"t{i}"
+            tenant_for.setdefault(route_tenant(t, slots), t)
+            i += 1
+        if len(tenant_for) < workers:
+            print(f"ERROR: ring left slots tenant-less: {sorted(tenant_for)}",
+                  file=sys.stderr)
+            return 3
+        for s in sorted(tenant_for):
+            out = sup.dispatch(req(f"warm_w{s}", tenant_for[s]))
+            if not (out.get("status") == "ok"
+                    and out.get("matches") == expect):
+                print(f"ERROR: warm-up on worker {s} not oracle-exact: "
+                      f"{out.get('status')} matches={out.get('matches')} "
+                      f"!= {expect}", file=sys.stderr)
+                return 3
+        victim = sorted(tenant_for)[0]
+        with faults.FaultInjector(seed=11, measurements=m).arm(
+                faults.FLEET_WORKER_KILL, at=1):
+            t0 = time.perf_counter()
+            out = sup.dispatch(req("kill", tenant_for[victim]))
+            failover_ms = (time.perf_counter() - t0) * 1e3
+        fleet = out.get("fleet") or {}
+        if not (out.get("status") == "ok" and out.get("matches") == expect):
+            print(f"ERROR: failover outcome not oracle-exact: "
+                  f"{out.get('status')} matches={out.get('matches')} "
+                  f"!= {expect} ({out.get('detail')})", file=sys.stderr)
+            return 3
+        if fleet.get("attempts", 1) < 2 or fleet.get("worker") == victim:
+            print(f"ERROR: no real failover happened: served by worker "
+                  f"{fleet.get('worker')} in {fleet.get('attempts')} "
+                  f"attempt(s) (victim was {victim})", file=sys.stderr)
+            return 3
+        report = sup.drain()
+    finally:
+        sup.close()
+    if report["unacked"] or report["double_exec"]:
+        print(f"ERROR: failover arm broke exactly-once at drain: "
+              f"{report}", file=sys.stderr)
+        return 3
+
+    # ---- cold arm: supervisor restart over a journal with the same
+    # death's unacknowledged intent — boot + replay + cold compile
+    cold_dir = os.path.join(tmp, "cold")
+    QueryJournal(cold_dir).append_intent(req("cold_kill", "t0"))
+    m2 = Measurements()
+    sup2 = FleetSupervisor(workers, worker_args, cold_dir,
+                           measurements=m2, lease_s=1.0)
+    try:
+        t0 = time.perf_counter()
+        sup2.start()
+        replayed = sup2.replay_unacknowledged()
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        report2 = sup2.drain()
+    finally:
+        sup2.close()
+    if not (len(replayed) == 1 and replayed[0].get("status") == "ok"
+            and replayed[0].get("matches") == expect):
+        print(f"ERROR: cold-restart replay not oracle-exact: {replayed}",
+              file=sys.stderr)
+        return 3
+    if report2["unacked"] or report2["double_exec"]:
+        print(f"ERROR: cold arm broke exactly-once at drain: {report2}",
+              file=sys.stderr)
+        return 3
+
+    speedup = cold_ms / max(failover_ms, 1e-9)
+    if speedup <= 1.0:
+        print(f"ERROR: failover was not faster than the cold restart: "
+              f"{failover_ms:.0f} ms vs {cold_ms:.0f} ms", file=sys.stderr)
+        return 3
+    print(f"note: kill-1-of-{workers}: failover {failover_ms:.0f} ms "
+          f"(survivor, attempt {fleet.get('attempts')}) vs cold "
+          f"supervisor restart {cold_ms:.0f} ms -> {speedup:.2f}x",
+          file=sys.stderr)
+
+    result = {
+        "metric": "fleet_failover_speedup",
+        "value": round(speedup, 3),
+        "unit": "cold_restart_over_failover_wall",
+        "workers": workers,
+        "queries": sup.queries,
+        "failover_ms": round(failover_ms, 1),
+        "cold_restart_ms": round(cold_ms, 1),
+        "failover": int(m.counters.get(FAILOVER, 0)),
+        "replayn": int(m.counters.get(REPLAYN, 0)),
+        "jdepth": int(m.counters.get(JDEPTH, 0)),
+        "wincarn": int(m.counters.get(WINCARN, 0)),
+        "worker_restarts": sup.restarts,
+        "double_exec": report["double_exec"] + report2["double_exec"],
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def main():
     # regression-gate post-step: parsed before any backend work so a typo'd
     # flag fails fast instead of after a multi-minute timed run
@@ -1437,6 +1596,12 @@ def main():
         # + statusz): CPU-sized like --grid-bench — it gates the
         # introspection plane's <1% overhead bar, not chip throughput
         sys.exit(_run_critpath_bench(check_baseline))
+    if "--fleet-bench" in argv:
+        # crash-only fleet failover A/B (service/fleet.py + journal.py):
+        # CPU-sized like --chaos/--serve-bench — it gates kill-1-of-4
+        # mid-query failover against the cold supervisor restart and the
+        # journal's exactly-once drain audit, not chip throughput
+        sys.exit(_run_fleet_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

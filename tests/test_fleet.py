@@ -1,0 +1,354 @@
+"""Crash-only fleet serving tests (service/fleet.py + journal.py).
+
+Fast unit coverage: the durable query journal's WAL + torn-line
+discipline, consistent-hash ring stability, /healthz readiness, the
+bounded session outcome window, postmortem incarnation grouping, and
+the regress-gate tag declarations (double_exec pinned to zero).
+
+Real-process coverage (each worker boot pays a JAX import, so these
+stay small and bounded): SIGKILL-one-of-two mid-query failover through
+the CLI, torn-intent replay across a supervisor restart, a fixed-seed
+``fleet.worker_kill`` mini-soak on one shared supervisor, and the
+graceful SIGTERM drain.  A randomized soak rides behind ``-m slow``.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_radix_join.performance.measurements import Measurements
+from tpu_radix_join.service.fleet import (FleetSupervisor, ring_points,
+                                          route_tenant)
+from tpu_radix_join.service.journal import (QueryJournal,
+                                            request_fingerprint)
+
+TPN = 1 << 10
+WORKER_ARGS = ["--nodes", "1", "--verify", "check"]
+
+
+def _req(qid, tenant="default", **kw):
+    kw.setdefault("tuples_per_node", TPN)
+    kw.setdefault("seed", 7)
+    return {"query_id": qid, "tenant": tenant, **kw}
+
+
+def _outcome_lines(out):
+    recs = [json.loads(line) for line in out.splitlines()
+            if line.startswith("{")]
+    return ([r for r in recs if r.get("event") == "outcome"],
+            next((r for r in recs if r.get("event") == "summary"), None))
+
+
+# ------------------------------------------------------------------ journal
+
+def test_journal_roundtrip_and_unacked_ordering(tmp_path):
+    j = QueryJournal(str(tmp_path))
+    ra, rb = _req("qa"), _req("qb")
+    fa, fb = request_fingerprint(ra), request_fingerprint(rb)
+    assert fa != fb
+    j.append_intent(ra, worker=0, incarnation="w0i1")
+    j.append_intent(rb, worker=1, incarnation="w1i1")
+    pend = j.unacknowledged()
+    assert [r["fp"] for r in pend] == [fa, fb]     # acceptance order
+    assert j.depth() == 2
+    j.append_outcome(fa, {"query_id": "qa", "status": "ok"}, worker=0)
+    assert [r["fp"] for r in j.unacknowledged()] == [fb]
+    assert j.outcome_for(fa) == {"query_id": "qa", "status": "ok"}
+    assert j.outcome_for(fb) is None
+    aud = j.audit()
+    assert (aud.intents, aud.outcomes, aud.unacked) == (2, 1, 1)
+    assert aud.double_exec == 0
+
+
+def test_journal_fingerprint_is_canonical():
+    a = {"query_id": "q", "tenant": "t", "tuples_per_node": 8, "seed": 1}
+    b = {"seed": 1, "tuples_per_node": 8, "tenant": "t", "query_id": "q"}
+    assert request_fingerprint(a) == request_fingerprint(b)
+    assert request_fingerprint(a) != request_fingerprint(
+        {**a, "seed": 2})
+
+
+def test_journal_first_outcome_wins_and_audit_counts_doubles(tmp_path):
+    j = QueryJournal(str(tmp_path))
+    r = _req("q")
+    fp = request_fingerprint(r)
+    j.append_intent(r)
+    j.append_outcome(fp, {"query_id": "q", "status": "ok", "matches": 1})
+    j.append_outcome(fp, {"query_id": "q", "status": "ok", "matches": 2})
+    # the client is owed the FIRST answer; the duplicate is the bug the
+    # audit exists to count
+    assert j.outcome_for(fp)["matches"] == 1
+    assert j.audit().double_exec == 1
+
+
+def test_journal_tolerates_torn_and_foreign_lines(tmp_path):
+    j = QueryJournal(str(tmp_path))
+    r = _req("q")
+    j.append_intent(r)
+    with open(j.path, "a") as f:
+        f.write('{"schema_version": 1, "kind": "intent", "fp": "torn')
+    # the torn tail of a SIGKILLed writer is skipped, not fatal, and the
+    # intact intent stays replayable
+    assert [row["query_id"] for row in j.unacknowledged()] == ["q"]
+    with open(j.path, "a") as f:
+        f.write("\n" + json.dumps({"schema_version": 99, "kind": "intent",
+                                   "fp": "future"}) + "\n")
+        f.write(json.dumps({"schema_version": 1, "kind": "gossip",
+                            "fp": "x"}) + "\n")
+    assert len(j.rows()) == 1                      # newer-schema + unknown kind skipped
+    assert j.audit().unacked == 1
+
+
+# --------------------------------------------------------------------- ring
+
+def test_ring_routing_is_deterministic_and_total():
+    slots = [0, 1, 2, 3]
+    assert ring_points(slots) == ring_points(slots)
+    owners = {f"t{i}": route_tenant(f"t{i}", slots) for i in range(64)}
+    assert set(owners.values()) <= set(slots)
+    assert len(set(owners.values())) == len(slots)  # 64 tenants cover 4 slots
+    assert route_tenant("t0", []) is None
+
+
+def test_ring_removal_moves_only_the_dead_slots_tenants():
+    slots = [0, 1, 2, 3]
+    before = {f"t{i}": route_tenant(f"t{i}", slots) for i in range(64)}
+    after = {t: route_tenant(t, [0, 2, 3]) for t in before}
+    for t, owner in before.items():
+        if owner == 1:
+            assert after[t] in (0, 2, 3)           # orphans re-home...
+        else:
+            assert after[t] == owner               # ...everyone else stays
+
+
+# ------------------------------------------------------------------ healthz
+
+def test_healthz_readiness_in_process():
+    from tpu_radix_join.observability.statusz import StatuszServer
+    s = StatuszServer()
+    code, body = s.health()
+    assert code == 200 and body["ok"]              # liveness-only default
+    s.set_readiness(lambda: {"ok": False, "reason": "breaker_open"})
+    code, body = s.health()
+    assert code == 503 and body["reason"] == "breaker_open"
+    s.set_readiness(lambda: True)
+    assert s.health()[0] == 200
+
+    def boom():
+        raise RuntimeError("introspection died")
+
+    s.set_readiness(boom)
+    code, body = s.health()
+    assert code == 503 and "introspection died" in body["reason"]
+
+
+def test_fleet_readiness_drain_and_no_workers(tmp_path):
+    sup = FleetSupervisor(1, WORKER_ARGS, str(tmp_path))
+    # never started: the slot is dead, nothing can take a query
+    assert sup.readiness() == {"ok": False, "reason": "no_healthy_worker"}
+    sup.draining = True
+    assert sup.readiness() == {"ok": False, "reason": "draining"}
+
+
+# --------------------------------------------------- bounded outcome window
+
+def test_session_outcomes_window_is_bounded():
+    from tpu_radix_join.core.config import ServiceConfig
+    from tpu_radix_join.service import JoinSession
+    from tpu_radix_join.core.config import JoinConfig
+    sess = JoinSession(JoinConfig(num_nodes=2),
+                       ServiceConfig(outcomes_keep=4))
+    try:
+        assert sess.outcomes.maxlen == 4
+    finally:
+        sess.close()
+    with pytest.raises(ValueError):
+        ServiceConfig(outcomes_keep=0)
+
+
+# -------------------------------------------------- postmortem incarnations
+
+def test_postmortem_merge_groups_by_worker_incarnation(tmp_path):
+    from tpu_radix_join.observability.postmortem import merge_bundles
+    paths = []
+    for i, winc in enumerate(["w0i1", "w0i2", "w0i2"]):
+        b = {"reason": "worker_death", "failure_class": "backend_unavailable",
+             "rank": 0, "created_epoch_s": 100.0 + i,
+             "ring": {"context": {"worker_incarnation": winc}}}
+        p = tmp_path / f"bundle_{i}.json"
+        p.write_text(json.dumps(b))
+        paths.append(str(p))
+    summary = merge_bundles(paths)
+    assert summary["by_worker_incarnation"] == {"w0i1": 1, "w0i2": 2}
+    assert [r["worker_incarnation"] for r in summary["rows"]] == [
+        "w0i1", "w0i2", "w0i2"]
+
+
+# --------------------------------------------------------- regress gate pins
+
+def test_fleet_bench_tags_gate_lower_is_better():
+    from tpu_radix_join.observability.regress import (extract_tags,
+                                                      higher_is_better,
+                                                      tag_is_declared)
+    for tag in ("failover_ms", "cold_restart_ms", "failover", "replayn",
+                "jdepth", "wincarn", "worker_restarts", "double_exec"):
+        assert tag_is_declared(tag), tag
+        assert not higher_is_better(tag), tag
+    # scenario descriptors are skipped, not gated
+    tags = extract_tags({"workers": 4, "queries": 5, "failover_ms": 500.0})
+    assert "workers" not in tags and "queries" not in tags
+
+
+def test_double_exec_regresses_from_zero_at_any_threshold():
+    from tpu_radix_join.observability.regress import compare_tags
+    rows = compare_tags({"double_exec": 0.0}, {"double_exec": 1.0},
+                        threshold=1e9)
+    assert [r["tag"] for r in rows
+            if r["status"] == "regressed"] == ["double_exec"]
+    assert not any(r["status"] == "regressed" for r in compare_tags(
+        {"double_exec": 0.0}, {"double_exec": 0.0}))
+
+
+# ----------------------------------------------- real-process fleet serving
+
+def test_fleet_cli_kill_mid_query_exactly_once(capsys, tmp_path):
+    """Tier-1 real-kill test: ``--fleet 2``, the 2nd dispatched query's
+    routed worker is SIGKILLed with the request on its pipe, and the
+    survivor serves the journal-replayed attempt — every query ends with
+    exactly one oracle-exact outcome, ``double_exec == 0``."""
+    from tpu_radix_join.main import main
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text("".join(
+        json.dumps(_req(f"q{i}")) + "\n" for i in range(3)))
+    d = tmp_path / "fleet"
+    rc = main(["--fleet", "2", "--serve", str(reqs), *WORKER_ARGS,
+               "--fleet-dir", str(d), "--fleet-kill-at", "2",
+               "--seed", "7"])
+    outcomes, summary = _outcome_lines(capsys.readouterr().out)
+    assert rc == 0
+    assert [o["query_id"] for o in outcomes] == ["q0", "q1", "q2"]
+    assert all(o["status"] == "ok" for o in outcomes)
+    assert all(o["matches"] == TPN for o in outcomes)   # nodes=1 oracle
+    killed = outcomes[1]
+    assert killed["fleet"]["attempts"] >= 2 and killed["fleet"]["replayed"]
+    assert summary["failover"] >= 1 and summary["replayn"] >= 1
+    assert summary["double_exec"] == 0 and summary["unacked"] == 0
+    assert summary["drain"]["double_exec"] == 0
+    # the journal on disk agrees with the summary it printed
+    aud = QueryJournal(str(d)).audit()
+    assert aud.double_exec == 0 and aud.unacked == 0
+    assert aud.outcomes == 3
+
+
+def test_torn_intent_replays_once_after_supervisor_restart(tmp_path):
+    """Satellite: a supervisor that died mid-append leaves one intact
+    unacknowledged intent and one torn line.  The restarted supervisor
+    replays the intact intent exactly once (the torn tail is skipped,
+    not resurrected), and a re-submission re-serves from the journal
+    without re-executing."""
+    d = str(tmp_path / "fleet")
+    j = QueryJournal(d)
+    r = _req("torn_q")
+    j.append_intent(r, worker=0, incarnation="w0i1")
+    with open(j.path, "a") as f:
+        f.write('{"schema_version": 1, "kind": "intent", "fp": "dead')
+    sup = FleetSupervisor(1, WORKER_ARGS, d, measurements=Measurements())
+    try:
+        sup.start()
+        outs = sup.replay_unacknowledged()
+        assert len(outs) == 1
+        assert outs[0]["status"] == "ok" and outs[0]["matches"] == TPN
+        assert sup.replay_unacknowledged() == []       # nothing left
+        again = sup.dispatch(r)
+        assert again["fleet"].get("served_from_journal")
+        assert again["matches"] == TPN
+        report = sup.drain()
+    finally:
+        sup.close()
+    assert report["unacked"] == 0 and report["double_exec"] == 0
+    aud = QueryJournal(d).audit()
+    assert aud.outcomes == 1 and aud.double_exec == 0
+
+
+def test_fleet_chaos_mini_soak_fixed_seeds(tmp_path):
+    """Tier-1 fixed-seed ``fleet.worker_kill`` mini-soak: two seeded kill
+    schedules through ONE shared supervisor — zero violations, zero
+    double executions, the supervisor survives its workers."""
+    from tpu_radix_join.robustness.chaos import FleetChaosRunner, soak_fleet
+    sup = FleetSupervisor(2, WORKER_ARGS, str(tmp_path / "fleet"),
+                          measurements=Measurements(),
+                          restart_backoff_s=0.05)
+    try:
+        runner = FleetChaosRunner(sup, queries=2, size=TPN,
+                                  bundle_dir=str(tmp_path / "bundles"))
+        outcomes, summary = soak_fleet(2, base_seed=3, runner=runner)
+    finally:
+        sup.close()
+    assert summary["violations"] == 0, [o.detail for o in outcomes]
+    assert summary["double_exec"] == 0 and summary["unacked"] == 0
+    assert summary["pass"] + summary["classified"] == 2
+
+
+def test_fleet_sigterm_drains_gracefully(tmp_path):
+    """SIGTERM with the request stream still open: admission stops,
+    served queries stay answered, the journal drains to zero
+    unacknowledged intents, every worker lease is withdrawn, exit 0."""
+    d = str(tmp_path / "fleet")
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = (repo + os.pathsep + env["PYTHONPATH"]
+                         if env.get("PYTHONPATH") else repo)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpu_radix_join.main", "--fleet", "1",
+         "--serve", "-", *WORKER_ARGS, "--fleet-dir", d],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True, bufsize=1, env=env)
+    try:
+        proc.stdin.write(json.dumps(_req("drain_q")) + "\n")
+        proc.stdin.flush()
+        line = proc.stdout.readline()              # the served outcome
+        out = json.loads(line)
+        assert out["event"] == "outcome" and out["status"] == "ok"
+        proc.send_signal(signal.SIGTERM)           # stream still open
+        rest, _ = proc.communicate(timeout=120)
+    except Exception:
+        proc.kill()
+        raise
+    assert proc.returncode == 0
+    _, summary = _outcome_lines(line + rest)
+    assert summary is not None
+    assert summary["drain"]["unacked"] == 0
+    assert summary["drain"]["double_exec"] == 0
+    assert summary["drain"]["leases_left"] == []
+    leases = [os.path.join(root, f) for root, _, fs in os.walk(d)
+              for f in fs if f.startswith("lease_")]
+    assert leases == []                            # all withdrawn/swept
+    assert QueryJournal(d).audit().unacked == 0
+
+
+@pytest.mark.slow
+def test_fleet_chaos_soak_randomized(tmp_path):
+    """Randomized soak (slow ring): N random-seed kill schedules on one
+    supervisor; the seed prints so any violation is replayable."""
+    import random
+
+    from tpu_radix_join.robustness.chaos import FleetChaosRunner, soak_fleet
+    base_seed = random.SystemRandom().randrange(1 << 20)
+    print(f"fleet soak base_seed={base_seed}")
+    sup = FleetSupervisor(2, WORKER_ARGS, str(tmp_path / "fleet"),
+                          measurements=Measurements(),
+                          restart_backoff_s=0.05)
+    try:
+        runner = FleetChaosRunner(sup, queries=3, size=TPN,
+                                  bundle_dir=str(tmp_path / "bundles"))
+        outcomes, summary = soak_fleet(4, base_seed=base_seed,
+                                       runner=runner)
+    finally:
+        sup.close()
+    assert summary["violations"] == 0, [o.detail for o in outcomes]
+    assert summary["double_exec"] == 0 and summary["unacked"] == 0

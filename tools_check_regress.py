@@ -114,6 +114,28 @@ all lower-is-better — while ``hedgewin`` (fence wins per hedge round)
 is pinned higher-is-better: fewer wins at the same hedge count means
 the detector started hedging partitions that were about to finish.
 
+A ``--fleet-bench`` BENCH json gates the crash-only fleet failover A/B
+(service/fleet.py + journal.py — SIGKILL one of four supervised serve
+workers mid-query vs the cold supervisor restart it replaces):
+
+    {"metric": "fleet_failover_speedup", "value": 7.72, "workers": 4,
+     "queries": 5, "failover_ms": 518.1, "cold_restart_ms": 3998.7,
+     "failover": 1, "replayn": 1, "jdepth": 1, "wincarn": 4,
+     "worker_restarts": 0, "double_exec": 0}
+
+The headline ``value`` is the wall ratio (cold restart over failover,
+higher is better).  ``failover_ms``/``cold_restart_ms`` are walls;
+``failover`` (mid-query deaths failed over), ``replayn`` (journal
+intents replayed), ``jdepth`` (peak unacknowledged journal depth),
+``wincarn`` (worker incarnations spawned), and ``worker_restarts`` are
+pinned lower-is-better: a fleet that starts burning more incarnations
+or replays per round regresses even when each query still lands
+oracle-exact.  ``double_exec`` is pinned to ZERO — it counts
+fingerprints with more than one journaled outcome, the exactly-once
+invariant, and because its baseline is 0 any growth is an infinite
+relative delta: a single double execution fails this gate at every
+threshold, no ``--allow`` precedent.
+
 The ``--recovery-bench --grow`` arm gates mid-run admission vs fixed
 survivors (rank admission re-expanding the assignment map):
 

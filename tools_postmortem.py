@@ -81,6 +81,13 @@ def main(argv=None) -> int:
             print(f"by failure class: {summary['by_failure_class']}")
             print(f"by rank:          {summary['by_rank']}")
             print(f"by mesh epoch:    {summary['by_membership_epoch']}")
+            # fleet workers stamp w<slot>i<n> incarnation ids; a crash-
+            # looping slot's bundles then read as one timeline per
+            # incarnation.  Suppressed when nothing was stamped (every
+            # bundle groups under "None" for non-fleet runs).
+            incarn = summary.get("by_worker_incarnation") or {}
+            if set(incarn) - {"None"}:
+                print(f"by incarnation:   {incarn}")
             if summary["recovery_timeline"]:
                 # grouped by membership epoch: every epoch's block reads
                 # as one fencing story — what changed the membership
@@ -134,10 +141,12 @@ def main(argv=None) -> int:
                        if row.get("trace_id") else "")
                 mep = (f" epoch={row['membership_epoch']}"
                        if row.get("membership_epoch") is not None else "")
+                winc = (f" incarnation={row['worker_incarnation']}"
+                        if row.get("worker_incarnation") is not None else "")
                 print(f"  {row['path']}: {row['reason']} "
                       f"[{row['failure_class']}] rank={row['rank']} "
                       f"strategy={row.get('strategy')}{drift}{qid}{tid}"
-                      f"{mep}")
+                      f"{mep}{winc}")
                 # per-query critical-path breakdown: which rank's which
                 # phase bounded this bundle's join, and how much of it
                 # was waiting (rows without one cost nothing)

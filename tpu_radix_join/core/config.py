@@ -403,6 +403,12 @@ class ServiceConfig:
     breaker_threshold: int = 3       # consecutive backend failures to trip
     breaker_cooldown_s: float = 30.0  # open -> half-open promotion delay
 
+    # --- outcome retention (service/session.py) --------------------------
+    outcomes_keep: int = 512         # recent QueryOutcomes kept in memory;
+                                     # the SLO recorder owns the aggregates,
+                                     # so a week-long worker must not grow
+                                     # this list with every query served
+
     def __post_init__(self):
         if self.max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
@@ -415,6 +421,8 @@ class ServiceConfig:
             raise ValueError("breaker_threshold must be >= 1")
         if self.breaker_cooldown_s < 0:
             raise ValueError("breaker_cooldown_s must be >= 0")
+        if self.outcomes_keep < 1:
+            raise ValueError("outcomes_keep must be >= 1")
 
     def replace(self, **kw) -> "ServiceConfig":
         return dataclasses.replace(self, **kw)

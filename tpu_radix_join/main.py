@@ -240,6 +240,32 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode: default per-query latency budget "
                         "(requests may override with their own deadline_s; "
                         "expiry -> deadline_exceeded)")
+    p.add_argument("--fleet", type=int, default=None, metavar="N",
+                   help="crash-only fleet serving (service/fleet.py): "
+                        "supervise N --serve worker subprocesses, route "
+                        "queries by consistent hash on tenant, health-check "
+                        "workers by lease heartbeat (two missed beats = "
+                        "lapse, the rank-lapse rule), restart dead workers "
+                        "with exponential backoff + a crash-loop breaker, "
+                        "and guarantee exactly-once outcomes through the "
+                        "durable query journal (intent before dispatch, "
+                        "outcome before reply, replay on death); SIGTERM "
+                        "drains gracefully.  Requires --serve FILE|-; "
+                        "--statusz gains a fleet section and a readiness-"
+                        "aware /healthz")
+    p.add_argument("--fleet-dir", default=None,
+                   help="fleet work dir: the query journal plus per-worker "
+                        "lease/timeline artifacts live here (default: "
+                        "fleet/ under --output-dir or --timeline-dir, else "
+                        "a private tempdir — restart the supervisor over "
+                        "the SAME dir to replay unacknowledged intents)")
+    p.add_argument("--fleet-kill-at", type=int, default=None, metavar="N",
+                   help="arm the fleet.worker_kill chaos site at the N-th "
+                        "dispatched query (1-based): the routed worker is "
+                        "SIGKILLed right after the request hits its pipe, "
+                        "and the supervisor must journal-replay it on a "
+                        "healthy worker (seeded from --seed, mirrors "
+                        "--rank-death-at)")
     p.add_argument("--statusz", type=int, default=None, metavar="PORT",
                    help="serve mode: expose a read-only live-introspection "
                         "HTTP endpoint on 127.0.0.1:PORT "
@@ -590,6 +616,8 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
     the backend goes dark.  One outcome JSON line per query on stdout,
     then a summary line carrying the SLO snapshot."""
     import json as _json
+    import os
+    import time as _time
 
     import jax
 
@@ -632,6 +660,13 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
                           elastic_grow=args.elastic_grow,
                           hedge=args.hedge,
                           hedge_threshold=args.hedge_threshold)
+    # fleet workers are spawned with an incarnation id (w<slot>i<n>,
+    # service/fleet.py); stamping it into the flight-recorder context
+    # makes every forensics bundle this worker writes group per
+    # incarnation under tools_postmortem.py --merge
+    incarnation = os.environ.get("TPU_RJ_WORKER_INCARNATION")
+    if incarnation:
+        meas.flightrec.set_context(worker_incarnation=incarnation)
     if sampler is not None:
         # heartbeat ticks carry the live SLO/breaker snapshot in serve mode;
         # with membership attached the lease write rides the same tick
@@ -667,7 +702,28 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
             "wasted": int(meas.counters.get(SPECWASTE, 0))})
         sections["critical_paths"] = (
             lambda: list(session.recent_critical_paths))
-        statusz = StatuszServer(port=args.statusz, sections=sections)
+
+        def _readiness():
+            # /healthz readiness: closed session, open breaker, or a
+            # stale own-lease heartbeat all mean "do not route here" —
+            # 503 with the reason, so the fleet supervisor or an
+            # external LB can act on the status code alone
+            from tpu_radix_join.service.breaker import OPEN as _BRK_OPEN
+            if session._closed:
+                return {"ok": False, "reason": "session_closed"}
+            if session.breaker.state == _BRK_OPEN:
+                return {"ok": False, "reason": "breaker_open"}
+            if membership is not None:
+                lease = membership.board.read(membership.board.rank)
+                if lease is not None:
+                    age = _time.time() - lease.t_epoch_s
+                    if age > membership.board.lapse_window_s:
+                        return {"ok": False,
+                                "reason": f"heartbeat_stale_{age:.1f}s"}
+            return {"ok": True}
+
+        statusz = StatuszServer(port=args.statusz, sections=sections,
+                                readiness=_readiness)
         statusz.start()
         print(f"[STATUSZ] serving http://127.0.0.1:{statusz.port}"
               "/statusz", file=sys.stderr)
@@ -728,6 +784,173 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         if statusz is not None:
             statusz.stop()
         session.close()
+
+
+def _run_fleet(args) -> int:
+    """Crash-only fleet supervision (``--fleet N``): own N ``--serve -``
+    worker subprocesses behind the journal's exactly-once discipline.
+
+    The supervisor reads the same JSONL request stream serve mode does,
+    but each query is intent-journaled, routed by tenant hash to a live
+    worker, and outcome-journaled before the client sees the reply; a
+    worker SIGKILLed mid-query fails over (replay on a healthy worker),
+    and a SIGTERM to the supervisor drains gracefully — admission stops,
+    in-flight queries finish, workers exit cleanly (withdrawing their own
+    leases), and the journal ends with zero unacknowledged intents.
+    Exit 0 = every accepted query got exactly one outcome."""
+    import contextlib
+    import json as _json
+    import os
+    import queue as _queue
+    import signal as _signal
+    import tempfile
+    import threading
+
+    from tpu_radix_join.performance.measurements import Measurements
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.service.fleet import FleetSupervisor
+
+    work_dir = (args.fleet_dir
+                or (os.path.join(args.output_dir, "fleet")
+                    if args.output_dir else None)
+                or (os.path.join(args.timeline_dir, "fleet")
+                    if args.timeline_dir else None)
+                or tempfile.mkdtemp(prefix="tpu_rj_fleet_"))
+
+    # the workers inherit the supervisor's join/serve shape; requests
+    # carry the per-query knobs (tuples_per_node, seed, deadline_s, ...)
+    worker_args = []
+    if args.nodes:
+        worker_args += ["--nodes", str(args.nodes)]
+    if args.verify != "off":
+        worker_args += ["--verify", args.verify]
+    worker_args += ["--profile", args.profile,
+                    "--max-retries", str(args.max_retries),
+                    "--fallback", args.fallback,
+                    "--breaker-threshold", str(args.breaker_threshold),
+                    "--breaker-cooldown-s", str(args.breaker_cooldown_s),
+                    "--serve-queue-depth", str(args.serve_queue_depth),
+                    "--serve-tenant-quota", str(args.serve_tenant_quota)]
+    if args.serve_deadline_s is not None:
+        worker_args += ["--serve-deadline-s", str(args.serve_deadline_s)]
+
+    meas = Measurements()
+    sup = FleetSupervisor(args.fleet, worker_args, work_dir,
+                          measurements=meas,
+                          lease_s=args.rank_lease_s,
+                          missed_beats=args.rank_missed_beats)
+
+    statusz = None
+    if args.statusz is not None:
+        from tpu_radix_join.observability.statusz import (
+            StatuszServer, measurements_sections)
+        sections = dict(measurements_sections(meas))
+        sections["fleet"] = sup.statusz_section
+        statusz = StatuszServer(port=args.statusz, sections=sections,
+                                readiness=sup.readiness)
+        statusz.start()
+        print(f"[STATUSZ] serving http://127.0.0.1:{statusz.port}"
+              "/statusz", file=sys.stderr)
+
+    # SIGTERM = graceful drain: the handler only flips a flag — the
+    # in-flight dispatch (the supervisor is single-threaded by design)
+    # finishes its query, then the loop sees the flag and drains
+    stop = threading.Event()
+
+    def _on_term(signum, frame):
+        stop.set()
+
+    prev_term = _signal.signal(_signal.SIGTERM, _on_term)
+
+    # requests arrive through a reader thread + queue so the serve loop
+    # can poll the stop flag: a blocking readline would ride out SIGTERM
+    # (PEP 475 retries it) and strand the drain until the next line
+    lineq: "_queue.Queue" = _queue.Queue()
+
+    def _read_lines(src):
+        try:
+            for line in src:
+                lineq.put(line)
+        finally:
+            lineq.put(None)
+
+    if args.serve == "-":
+        src = sys.stdin
+    else:
+        src = open(args.serve)
+    reader = threading.Thread(target=_read_lines, args=(src,),
+                              name="fleet-stdin", daemon=True)
+
+    def emit(out):
+        print(_json.dumps({"event": "outcome", **out}, default=str),
+              flush=True)
+
+    errors = 0
+    rc = 0
+    try:
+        with contextlib.ExitStack() as stack:
+            if args.fleet_kill_at is not None:
+                inj = faults.FaultInjector(seed=args.seed,
+                                           measurements=meas)
+                inj.arm(faults.FLEET_WORKER_KILL, at=args.fleet_kill_at)
+                stack.enter_context(inj)
+            sup.start()
+            # a previous incarnation's accepted-but-unanswered queries
+            # replay before any new admission — the restart half of
+            # exactly-once (each replayed outcome is emitted too, marked
+            # replayed, so the client is made whole)
+            replayed = sup.replay_unacknowledged(emit)
+            if replayed:
+                print(f"[FLEET] replayed {len(replayed)} unacknowledged "
+                      f"intent(s) from {sup.journal.path}",
+                      file=sys.stderr)
+            reader.start()
+            lineno = 0
+            while not stop.is_set():
+                try:
+                    line = lineq.get(timeout=0.2)
+                except _queue.Empty:
+                    continue
+                if line is None:
+                    break
+                lineno += 1
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                try:
+                    obj = _json.loads(line)
+                    if not isinstance(obj, dict):
+                        raise ValueError("request must be a JSON object")
+                    obj.setdefault("query_id", f"line{lineno}")
+                except (ValueError, TypeError) as e:
+                    errors += 1
+                    print(_json.dumps({"event": "request_error",
+                                       "line": lineno, "error": str(e)}),
+                          flush=True)
+                    continue
+                emit(sup.dispatch(obj))
+        report = sup.drain()
+        summary = {**sup.summary(), "drain": report}
+        print(_json.dumps({"event": "summary", **summary}, default=str),
+              flush=True)
+        if report["unacked"] or report["double_exec"]:
+            # a stranded or doubled query is the one failure this mode
+            # exists to rule out — fail loud
+            print(f"[FLEET] exactly-once violated at drain: "
+                  f"unacked={report['unacked']} "
+                  f"double_exec={report['double_exec']}", file=sys.stderr)
+            rc = 1
+        if errors:
+            rc = 1
+        return rc
+    finally:
+        sup.close()
+        if statusz is not None:
+            statusz.stop()
+        if src is not sys.stdin:
+            src.close()
+        _signal.signal(_signal.SIGTERM, prev_term)
+        _ledger_flush(args, meas)
 
 
 def _run_joiner(args, cfg, meas, nodes, *, membership) -> int:
@@ -889,6 +1112,15 @@ def main(argv=None) -> int:
                      "arms")
     if args.rank_missed_beats < 1:
         parser.error("--rank-missed-beats must be >= 1")
+    if args.fleet is not None:
+        if args.fleet < 1:
+            parser.error("--fleet needs at least one worker")
+        if args.serve is None:
+            parser.error("--fleet supervises --serve workers — pass "
+                         "--serve FILE (or '-' for stdin)")
+        if args.elastic_join is not None:
+            parser.error("--fleet is a serving supervisor, not a mesh "
+                         "rank; it cannot run as --elastic-join")
     if args.elastic_join is not None:
         if not args.checkpoint_dir:
             parser.error("--elastic-join recomputes through the shared "
@@ -910,6 +1142,11 @@ def main(argv=None) -> int:
         from tpu_radix_join.planner.profile import resolve_profile
         args.profile = resolve_profile("auto", ledger_dir=_ledger_dir(args))
         print(f"[PROFILE] auto -> {args.profile}", file=sys.stderr)
+
+    if args.fleet is not None:
+        # the supervisor never initializes devices — the workers own the
+        # mesh; dispatch before the driver's jax/device bring-up
+        return _run_fleet(args)
 
     import jax
 

@@ -302,6 +302,7 @@ def merge_bundles(paths) -> dict:
     classes: dict = {}
     ranks: dict = {}
     epochs: dict = {}
+    incarnations: dict = {}
     timeline = []
     rows = []
     t_min = t_max = None
@@ -336,6 +337,21 @@ def merge_bundles(paths) -> dict:
         if mepoch is None:
             mepoch = (b.get("extra") or {}).get("membership_epoch")
         epochs[str(mepoch)] = epochs.get(str(mepoch), 0) + 1
+        # worker incarnation: fleet workers (service/fleet.py) stamp their
+        # incarnation id (w<slot>i<n>) into the flight-recorder context at
+        # serve start, so a crash-looping slot's bundles — one per death —
+        # group into a single per-incarnation timeline instead of reading
+        # as unrelated failures.  Same extraction chain as the membership
+        # epoch above.
+        wincarn = (ring.get("context") or {}).get("worker_incarnation")
+        if wincarn is None:
+            for rec in reversed(ring.get("records") or []):
+                if "worker_incarnation" in rec:
+                    wincarn = rec["worker_incarnation"]
+                    break
+        if wincarn is None:
+            wincarn = (b.get("extra") or {}).get("worker_incarnation")
+        incarnations[str(wincarn)] = incarnations.get(str(wincarn), 0) + 1
         # the recovery timeline: membership + recovery events from every
         # bundle's event tail, aligned on the cross-process wall clock —
         # losses and recoveries, plus the growth/hedging vocabulary
@@ -352,6 +368,7 @@ def merge_bundles(paths) -> dict:
                      "trace_id": b.get("trace_id"),
                      "critical_path": b.get("critical_path"),
                      "membership_epoch": mepoch,
+                     "worker_incarnation": wincarn,
                      "strategy": pva.get("strategy")
                      or (b.get("plan") or {}).get("strategy"),
                      "drift_pct": pva.get("drift_pct"),
@@ -359,5 +376,7 @@ def merge_bundles(paths) -> dict:
     timeline.sort(key=lambda ev: ev.get("t_epoch_s") or 0)
     return {"bundles": len(rows), "by_reason": reasons,
             "by_failure_class": classes, "by_rank": ranks,
-            "by_membership_epoch": epochs, "recovery_timeline": timeline,
+            "by_membership_epoch": epochs,
+            "by_worker_incarnation": incarnations,
+            "recovery_timeline": timeline,
             "t_first": t_min, "t_last": t_max, "rows": rows}

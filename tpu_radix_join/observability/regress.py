@@ -138,7 +138,19 @@ _LOWER_BETTER_SUBSTRINGS = ("rejection_rate", "miss_rate", "degraded_rate",
                             # rank's path is collective-wait/straggle
                             # rather than work — a fleet-balance
                             # regression even when JTOTAL holds
-                            "critpath_overhead_pct", "wait_fraction")
+                            "critpath_overhead_pct", "wait_fraction",
+                            # fleet serving (--fleet-bench and the fleet
+                            # counters, service/fleet.py): failover wall,
+                            # replayed intents, journal depth, and worker
+                            # restarts per round all regress when they
+                            # GROW; double_exec is the exactly-once
+                            # invariant — its baseline is 0, so compare_
+                            # tags' zero-base rule makes ANY nonzero an
+                            # infinite delta: a hard fail at every
+                            # threshold, by design
+                            "failover", "replayn", "jdepth",
+                            "worker_restarts", "double_exec",
+                            "wincarn", "wrestart", "doubleexec")
 # Exact-name lower-is-better pins for the Measurements counter/timer
 # vocabulary (performance/measurements.py).  Historically these rode the
 # "unmatched tags default to cost" rule; the counter-tag lint rule
@@ -185,7 +197,10 @@ _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          # injected slowdown, the membership split, and the audit total
          # parameterize the arm, they do not measure it
          "straggle_factor", "survivors_fixed", "survivors_grown",
-         "manifest_total"}
+         "manifest_total",
+         # --fleet-bench scenario descriptors: pool size and per-arm query
+         # count parameterize the A/B, they do not measure it
+         "workers", "queries"}
 
 
 def higher_is_better(tag: str) -> bool:

@@ -20,8 +20,13 @@ Design constraints:
     session already pays.
 
 Routes: ``/statusz`` (all sections), ``/statusz/<section>`` (one),
-``/healthz`` (liveness ping).  Binds 127.0.0.1 only — this is an
-operator plane, not a public API.
+``/healthz`` (readiness).  ``/healthz`` consults an optional
+``readiness`` callable (the serve wiring supplies one): ``{"ok": true}``
+200 while the plane can take a query, ``{"ok": false, "reason": ...}``
+503 when it cannot (session closed, breaker open, heartbeat stale, fleet
+draining) — so the fleet supervisor or an external LB can route on the
+status code alone instead of parsing ``/statusz``.  Binds 127.0.0.1
+only — this is an operator plane, not a public API.
 """
 
 from __future__ import annotations
@@ -37,11 +42,13 @@ class StatuszServer:
     """Serve read-only JSON snapshots from registered section callables."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 sections: Optional[Dict[str, Callable[[], object]]] = None):
+                 sections: Optional[Dict[str, Callable[[], object]]] = None,
+                 readiness: Optional[Callable[[], object]] = None):
         self._host = host
         self._port = int(port)
         self._sections: Dict[str, Callable[[], object]] = dict(
             sections or {})
+        self._readiness = readiness
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self.requests_served = 0
@@ -50,6 +57,31 @@ class StatuszServer:
     def add_section(self, name: str, provider: Callable[[], object]
                     ) -> None:
         self._sections[name] = provider
+
+    def set_readiness(self, provider: Callable[[], object]) -> None:
+        """Install the ``/healthz`` readiness callable.  It returns either
+        a bool or a ``{"ok": bool, "reason": ...}`` dict; ``ok=False``
+        answers 503.  Without one, ``/healthz`` stays a liveness ping
+        (the process answering IS the health)."""
+        self._readiness = provider
+
+    def health(self) -> tuple:
+        """(status_code, body) for ``/healthz`` — testable in-process.
+        A readiness provider that *raises* reads as not-ready: a plane
+        that cannot even describe its health must not take traffic."""
+        body = {"ok": True, "t_epoch_s": time.time()}
+        if self._readiness is not None:
+            try:
+                verdict = self._readiness()
+            except Exception as e:     # noqa: BLE001 — render, never raise
+                verdict = {"ok": False,
+                           "reason": f"readiness error: "
+                                     f"{type(e).__name__}: {e}"}
+            if isinstance(verdict, dict):
+                body.update(verdict)
+            else:
+                body["ok"] = bool(verdict)
+        return (200 if body.get("ok") else 503), body
 
     def _render_section(self, name: str) -> object:
         provider = self._sections.get(name)
@@ -85,8 +117,9 @@ class StatuszServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (http.server API)
                 path = self.path.split("?", 1)[0].rstrip("/")
+                code = 200
                 if path == "/healthz":
-                    body = {"ok": True, "t_epoch_s": time.time()}
+                    code, body = server.health()
                 elif path == "/statusz":
                     body = server.snapshot()
                 elif path.startswith("/statusz/"):
@@ -97,7 +130,7 @@ class StatuszServer:
                 # default=str: snapshots may carry exotica (paths, enums)
                 data = json.dumps(body, default=str).encode()
                 server.requests_served += 1
-                self.send_response(200)
+                self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()

@@ -151,6 +151,15 @@ SORTFALLBACK = "SORTFALLBACK"  # sort auto-select degraded to lax.sort
                            # ONCE per process (the decision is per-process,
                            # not per-sort) and paired with a log-once
                            # stderr line; 1 on a TPU backend is a regression
+FAILOVER = "FAILOVER"      # fleet queries failed over to another worker after
+                           # the routed worker died mid-query (service/fleet.py)
+REPLAYN = "REPLAYN"        # journal intents replayed (failover retries plus
+                           # restart-time unacknowledged-intent replay)
+WINCARN = "WINCARN"        # fleet worker incarnations spawned (boot + restarts)
+WRESTART = "WRESTART"      # dead-worker restarts (WINCARN minus the boot pool)
+JDEPTH = "JDEPTH"          # gauge: peak unacknowledged query-journal depth
+DOUBLEEXEC = "DOUBLEEXEC"  # fingerprints with >1 journaled outcome — the
+                           # exactly-once invariant; any nonzero is a bug
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

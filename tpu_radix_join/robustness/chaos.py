@@ -603,3 +603,155 @@ def write_repro(outcome: RunOutcome, path) -> str:
     with open(path, "w") as f:
         f.write(line + "\n")
     return line
+
+
+# --------------------------------------------------------------------- fleet
+#: sites the fleet supervisor's dispatch loop consults (service/fleet.py):
+#: the worker-kill site fires right after a query hits a worker's pipe, so
+#: the hit index IS the dispatched-query index (replay attempts re-consult
+#: it — a schedule can kill the replay's worker too)
+FLEET_SITES: Tuple[str, ...] = (
+    faults.FLEET_WORKER_KILL,
+)
+
+
+def generate_fleet_schedule(seed: int, queries: int = 4) -> Schedule:
+    """One ``fleet.worker_kill`` arm at a seeded dispatch index — mid-
+    stream worker death, fully determined by ``seed``.  Kept to a single
+    site (the only one the supervisor consults) so shrinking degenerates
+    to "the kill did it"; the interesting variation is WHERE in the
+    stream the kill lands."""
+    rng = random.Random(seed)
+    site = rng.choice(FLEET_SITES)
+    return Schedule(seed=seed,
+                    arms=((site, (("at", rng.randint(1, max(1, queries))),)),))
+
+
+class FleetChaosRunner:
+    """Executes ``fleet.worker_kill`` schedules against ONE resident
+    :class:`~tpu_radix_join.service.fleet.FleetSupervisor`.
+
+    The supervisor is shared across runs by design: worker boot is the
+    expensive part (a JAX import + device init per subprocess), and a
+    crash-only supervisor is *supposed* to keep serving across arbitrary
+    worker deaths — reusing it across schedules IS the soak.  The
+    invariant per run: **every dispatched query returns exactly one
+    outcome, oracle-exact (``matches == expected``) or classified, the
+    journal audit counts zero double-executions, and the supervisor
+    survives the stream**.  An escaped exception, an unclassified
+    outcome, a silent wrong count, or ``double_exec > 0`` is a
+    VIOLATION.
+    """
+
+    def __init__(self, supervisor, queries: int = 3, size: int = 1 << 10,
+                 data_seed: int = 0, bundle_dir: Optional[str] = None):
+        self.supervisor = supervisor
+        self.queries = queries
+        self.size = size
+        self.data_seed = data_seed
+        self.bundle_dir = bundle_dir
+        self.measurements: List[Any] = []
+
+    def run(self, schedule: Schedule) -> RunOutcome:
+        out = self._run(schedule)
+        if out.status == VIOLATION and self.measurements:
+            out = dataclasses.replace(out, bundle=_violation_bundle(
+                self.measurements[-1], schedule, out.detail,
+                self.bundle_dir))
+        return out
+
+    def _run(self, schedule: Schedule) -> RunOutcome:
+        from tpu_radix_join.service import UNCLASSIFIED
+        sup = self.supervisor
+        m = sup.measurements
+        if m is not None:
+            self.measurements.append(m)
+        inj = faults.FaultInjector(seed=schedule.seed, measurements=m)
+        for site, kw in schedule.arm_dicts():
+            inj.arm(site, **kw)
+        outs = []
+        try:
+            with inj:
+                for i in range(self.queries):
+                    # seed-qualified ids keep fingerprints distinct across
+                    # runs — the journal dedup must only collapse genuine
+                    # re-submissions, not the soak's fresh queries
+                    request = {"query_id": f"s{schedule.seed}q{i}",
+                               "tenant": f"t{i % 2}",
+                               "tuples_per_node": self.size,
+                               "seed": self.data_seed}
+                    outs.append(sup.dispatch(request))
+        except Exception as e:      # noqa: BLE001 — the invariant itself
+            return RunOutcome(schedule, VIOLATION, None, None,
+                              f"supervisor died at query {len(outs)}: {e!r}")
+        detail = " ".join(
+            f"{o.get('query_id')}={o.get('status')}/{o.get('failure_class')}"
+            for o in outs)
+        audit = sup.journal.audit()
+        if audit.double_exec:
+            return RunOutcome(schedule, VIOLATION, None, None,
+                              f"{audit.double_exec} double-executed "
+                              f"fingerprint(s) in the journal: {detail}")
+        for o in outs:
+            if o is None:
+                return RunOutcome(schedule, VIOLATION, None, None,
+                                  f"query vanished without an outcome: "
+                                  f"{detail}")
+            if o.get("failure_class") == UNCLASSIFIED:
+                return RunOutcome(schedule, VIOLATION, None, o.get("matches"),
+                                  f"unclassified query outcome: {detail}")
+            if (o.get("status") == "ok" and o.get("expected") is not None
+                    and o.get("matches") != o.get("expected")):
+                return RunOutcome(
+                    schedule, VIOLATION, None, o.get("matches"),
+                    f"silent wrong count on {o.get('query_id')}: "
+                    f"{o.get('matches')} != oracle {o.get('expected')} "
+                    f"({detail})")
+        classes = sorted({o["failure_class"] for o in outs
+                          if o.get("failure_class")
+                          and o["failure_class"] != "ok"})
+        last_ok = next((o.get("matches") for o in reversed(outs)
+                        if o.get("status") == "ok"), None)
+        if not classes:
+            return RunOutcome(schedule, PASS, None, last_ok, detail)
+        return RunOutcome(schedule, CLASSIFIED, ",".join(classes),
+                          last_ok, detail)
+
+
+def soak_fleet(runs: int, base_seed: int = 0,
+               runner: Optional[FleetChaosRunner] = None,
+               supervisor=None,
+               on_outcome: Optional[Callable[[RunOutcome], None]] = None):
+    """N seeded ``fleet.worker_kill`` streams through one
+    :class:`FleetChaosRunner`; same return shape as :func:`soak_session`,
+    plus the supervisor-side exactly-once accounting (failovers, replays,
+    restarts, the final journal audit)."""
+    if runner is None:
+        if supervisor is None:
+            raise ValueError("soak_fleet needs a runner or a supervisor")
+        runner = FleetChaosRunner(supervisor)
+    outcomes = []
+    for i in range(runs):
+        out = runner.run(generate_fleet_schedule(base_seed + i,
+                                                 runner.queries))
+        outcomes.append(out)
+        if on_outcome:
+            on_outcome(out)
+    sup = runner.supervisor
+    audit = sup.journal.audit()
+    summary = {
+        "runs": runs,
+        "base_seed": base_seed,
+        "queries_per_run": runner.queries,
+        "pass": sum(o.status == PASS for o in outcomes),
+        "classified": sum(o.status == CLASSIFIED for o in outcomes),
+        "violations": sum(o.status == VIOLATION for o in outcomes),
+        "failure_classes": sorted({c for o in outcomes if o.failure_class
+                                   for c in o.failure_class.split(",")}),
+        "failovers": sup.failovers,
+        "replays": sup.replays,
+        "worker_restarts": sup.restarts,
+        "double_exec": audit.double_exec,
+        "unacked": audit.unacked,
+    }
+    return outcomes, summary

@@ -72,11 +72,17 @@ COMPUTE_STRAGGLE = "compute.straggle"          # a live rank slows down by a
                                                # its unfinished partitions,
                                                # never declare it dead
                                                # (robustness/straggler.py)
+FLEET_WORKER_KILL = "fleet.worker_kill"        # SIGKILL a fleet worker right
+                                               # after its query hit the pipe:
+                                               # the supervisor must journal-
+                                               # replay the query on a healthy
+                                               # worker, exactly one outcome
+                                               # (service/fleet.py dispatch)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
          CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL, RANK_DEATH,
-         RANK_JOIN, COMPUTE_STRAGGLE)
+         RANK_JOIN, COMPUTE_STRAGGLE, FLEET_WORKER_KILL)
 
 
 class InjectedFault(RuntimeError):

@@ -43,6 +43,10 @@ and surfaced by main.py / bench reports):
   * ``admission_rejected``   — the resident service refused the query at
     the door (queue depth or per-tenant quota, service/admission.py).
     The query never ran; resubmitting later is safe by construction.
+  * ``request_error``        — the request line itself was malformed or
+    unservable, so a serve worker refused it (service/fleet.py).  FATAL
+    and worker-independent: the same line fails on every worker, so the
+    fleet classifies instead of failing over — the fix is the client's.
   * ``deadline_exceeded``    — the query's latency budget expired between
     pipeline phases (service/deadline.py cooperative cancellation).
   * ``rank_lost``            — a peer rank's membership lease lapsed
@@ -76,6 +80,7 @@ CHECKPOINT_MISMATCH = "checkpoint_mismatch"
 RETRIES_EXHAUSTED = "retries_exhausted"
 BACKEND_UNAVAILABLE = "backend_unavailable"
 ADMISSION_REJECTED = "admission_rejected"
+REQUEST_ERROR = "request_error"
 DEADLINE_EXCEEDED = "deadline_exceeded"
 RANK_LOST = "rank_lost"
 RANK_JOIN = "rank_join"

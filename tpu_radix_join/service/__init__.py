@@ -12,7 +12,13 @@ Public surface:
   * :class:`CircuitBreaker` — closed/open/half-open routing over the
     chip backend (breaker.py);
   * :class:`SLORecorder` — per-tenant latency percentiles and outcome
-    rates (slo.py).
+    rates (slo.py);
+  * :class:`QueryJournal` / :func:`request_fingerprint` — the durable
+    intent/outcome WAL behind exactly-once fleet serving (journal.py);
+  * :class:`FleetSupervisor` — crash-only supervision of N serve
+    workers: consistent-hash routing, heartbeat health checks, backoff
+    restarts, crash-loop quarantine, journal replay, graceful drain
+    (fleet.py).
 """
 
 from tpu_radix_join.service.admission import (AdmissionQueue,
@@ -20,6 +26,10 @@ from tpu_radix_join.service.admission import (AdmissionQueue,
 from tpu_radix_join.service.breaker import (CLOSED, HALF_OPEN, OPEN,
                                             CircuitBreaker)
 from tpu_radix_join.service.deadline import Deadline, DeadlineExceeded
+from tpu_radix_join.service.fleet import (FleetSupervisor, ring_points,
+                                          route_tenant)
+from tpu_radix_join.service.journal import (JournalAudit, QueryJournal,
+                                            request_fingerprint)
 from tpu_radix_join.service.session import (BackendUnavailable, JoinSession,
                                             QueryOutcome, QueryRequest,
                                             UNCLASSIFIED)
@@ -29,6 +39,8 @@ __all__ = [
     "AdmissionQueue", "AdmissionRejected",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "Deadline", "DeadlineExceeded",
+    "FleetSupervisor", "ring_points", "route_tenant",
+    "JournalAudit", "QueryJournal", "request_fingerprint",
     "JoinSession", "QueryRequest", "QueryOutcome", "BackendUnavailable",
     "UNCLASSIFIED",
     "SLORecorder", "nearest_rank",

@@ -207,7 +207,11 @@ class JoinSession:
             collections.OrderedDict()
         self._sampler = None            # attached heartbeat, owned if set
         self._closed = False
-        self.outcomes: List[QueryOutcome] = []
+        #: recent outcomes only (maxlen = service.outcomes_keep): the SLO
+        #: recorder is the source of truth for aggregates, so a long-lived
+        #: serve worker keeps a bounded window, not its whole history
+        self.outcomes: "collections.deque" = collections.deque(
+            maxlen=self.service.outcomes_keep)
         #: last N per-query critical paths (observability/critpath.py),
         #: window-sliced from the attached tracer around each executed
         #: query — the ``/statusz`` critical_paths section reads this
