@@ -791,6 +791,319 @@ def _run_serve_bench(check_baseline=None, queries=20, chaos=False):
     return 0
 
 
+def _run_serve_throughput_bench(check_baseline=None):
+    """``--serve-throughput-bench``: the serving fast-path A/B for the
+    three gated tiers (ROADMAP serving throughput: result cache,
+    micro-batching, delta-merge), all on host CPU.
+
+    Four legs, each oracle-exact or exit 3:
+
+      * **cache** — one session with the fingerprint result cache on: a
+        timed cold execution vs the timed repeat of the SAME content.
+        The repeat must come back ``served_by=cache_hit`` with the cold
+        answer and >= 10x faster (the tier exists to skip admission and
+        execution entirely, so anything less means it executed).
+      * **batch** — per batch size Q in {2, 4, 8}: a serial drain of Q
+        co-signature queries vs the SAME queries drained through ONE
+        fused device program (``served_by=batched``).  Warm pass first
+        so both arms price steady-state serving, not compilation; the
+        fused arm must beat serial by >= 1.5x at Q=4.
+      * **delta** — per Δ/N in {1/16, 1/64, 1/256}: a resident session
+        absorbing three deltas O(N+Δ) (``served_by=delta_merge``, the
+        unchanged-outer incremental probe) vs the budget-0 posture
+        re-sorting and re-probing from scratch every query; >= 2x at
+        Δ/N = 1/64.
+      * **fleet chaos** — a 2-worker fleet coalescing a 4-query
+        co-batchable group through one worker, SIGKILLed mid-batch
+        (``fleet.worker_kill``): every query must still end oracle-exact
+        through journaled failover and the drain audit must report
+        ``unacked == 0`` and ``double_exec == 0``.
+
+    The statusz leg polls a live ``/statusz`` 5 times plus ``/healthz``
+    against the cache/batch sections while the session serves — the
+    introspection plane must answer every poll mid-serving.
+
+    The BENCH headline ``value`` is the Q=4 fused-over-serial speedup;
+    cache_speedup / delta_speedup / batch_fuse_ratio and the six serving
+    counters ride as tags, direction-gated under tools_check_regress.py
+    (double_exec pins to zero)."""
+    from tpu_radix_join.utils.platform import force_host_cpu_devices
+    force_host_cpu_devices(8, respect_existing=True)
+
+    import statistics
+    import tempfile
+    import urllib.request
+
+    from tpu_radix_join.core.config import JoinConfig, ServiceConfig
+    from tpu_radix_join.observability.statusz import StatuszServer
+    from tpu_radix_join.performance import Measurements
+    from tpu_radix_join.performance.measurements import (BATCHN, BATCHQ,
+                                                         DELTAMERGE, FAILOVER,
+                                                         RCHIT, RCMISS,
+                                                         RESBYTES)
+    from tpu_radix_join.robustness import faults
+    from tpu_radix_join.service import JoinSession, QueryRequest
+    from tpu_radix_join.service.fleet import FleetSupervisor
+
+    cfg = JoinConfig(num_nodes=8)
+    bad = []
+
+    def exact(out, leg):
+        if not (out is not None and out.status == "ok"
+                and out.expected is not None
+                and out.matches == out.expected):
+            bad.append(
+                f"{leg}: {getattr(out, 'query_id', None)} not oracle-exact "
+                f"({getattr(out, 'status', 'missing')} "
+                f"matches={getattr(out, 'matches', None)} "
+                f"expected={getattr(out, 'expected', None)} "
+                f"{getattr(out, 'detail', '')})")
+            return False
+        return True
+
+    # ---- leg 1: result cache + the statusz/healthz liveness poll
+    svc = ServiceConfig(result_cache_max=8, batch_window_ms=25.0,
+                        batch_max_queries=8, default_deadline_s=300.0)
+    meas = Measurements(node_id=0, num_nodes=8)
+    session = JoinSession(cfg, svc, measurements=meas)
+    statusz = StatuszServer(port=0, sections={
+        "cache": lambda: session.result_cache.stats(),
+        "batch": lambda: {"fused_batches": session.batches_fused,
+                          "fused_queries": session.batch_queries_fused},
+    })
+    statusz.start()
+    url = f"http://127.0.0.1:{statusz.port}"
+    polls = 0
+    try:
+        session.submit(QueryRequest(query_id="warm",
+                                    tuples_per_node=1 << 13, seed=3))
+        session.run_next()          # engine + compile warm-up, seed 3
+        t0 = time.perf_counter()
+        session.submit(QueryRequest(query_id="cold",
+                                    tuples_per_node=1 << 13, seed=5))
+        cold = session.run_next()
+        cold_ms = (time.perf_counter() - t0) * 1e3
+        hit = session.try_cache(QueryRequest(query_id="hit",
+                                             tuples_per_node=1 << 13,
+                                             seed=5))
+        # 5-poll liveness against the serving session: every poll must
+        # answer with the cache/batch sections present, plus /healthz
+        for _ in range(5):
+            with urllib.request.urlopen(f"{url}/statusz",
+                                        timeout=5) as resp:
+                page = json.loads(resp.read())
+            if "cache" not in page.get("sections", page):
+                bad.append("statusz poll lost the cache section")
+            polls += 1
+        with urllib.request.urlopen(f"{url}/healthz", timeout=5) as resp:
+            if resp.status != 200:
+                bad.append(f"/healthz answered {resp.status}")
+        cache_stats = session.result_cache.stats()
+    finally:
+        statusz.stop()
+        session.close()
+    exact(cold, "cache-cold")
+    if hit is None or hit.served_by != "cache_hit":
+        bad.append(f"repeat content did not cache-serve "
+                   f"(served_by={getattr(hit, 'served_by', None)})")
+        cache_speedup = 0.0
+        hit_ms = float("nan")
+    else:
+        exact(hit, "cache-hit")
+        if hit.matches != cold.matches:
+            bad.append(f"cache hit answer drifted: {hit.matches} != "
+                       f"{cold.matches}")
+        hit_ms = hit.latency_ms
+        cache_speedup = cold_ms / max(hit_ms, 1e-9)
+        if cache_speedup < 10.0:
+            bad.append(f"cache hit only {cache_speedup:.1f}x over cold "
+                       f"({hit_ms:.3f} vs {cold_ms:.1f} ms); gate is 10x")
+    if polls < 5:
+        bad.append(f"only {polls}/5 statusz polls answered")
+
+    # ---- leg 2: micro-batch fuse A/B at Q = 2, 4, 8
+    def batch_arm(q, fuse, tag):
+        svc = ServiceConfig(batch_window_ms=50.0 if fuse else 0.0,
+                            batch_max_queries=8, default_deadline_s=300.0)
+        m2 = Measurements(node_id=0, num_nodes=8)
+        s2 = JoinSession(cfg, svc, measurements=m2)
+        try:
+            walls = []
+            outs = []
+            for rnd in ("w", "t"):          # warm pass, then timed pass
+                for i in range(q):
+                    s2.submit(QueryRequest(query_id=f"{tag}{rnd}{i}",
+                                           tuples_per_node=1 << 10,
+                                           seed=23))
+                t0 = time.perf_counter()
+                outs = s2.drain(batched=fuse)
+                walls.append((time.perf_counter() - t0) * 1e3)
+            for o in outs:
+                exact(o, f"batch-q{q}-{'fused' if fuse else 'serial'}")
+                want = "batched" if fuse else "execute"
+                if o.served_by != want:
+                    bad.append(f"{o.query_id}: served_by={o.served_by}, "
+                               f"want {want}")
+            return walls[-1], m2
+        finally:
+            s2.close()
+
+    batch_speedups = {}
+    batchn = batchq = 0
+    for q in (2, 4, 8):
+        serial_ms, _ = batch_arm(q, fuse=False, tag=f"s{q}")
+        fused_ms, mf = batch_arm(q, fuse=True, tag=f"f{q}")
+        batchn += int(mf.counters.get(BATCHN, 0))
+        batchq += int(mf.counters.get(BATCHQ, 0))
+        batch_speedups[q] = serial_ms / max(fused_ms, 1e-9)
+        print(f"note: batch q={q}: serial {serial_ms:.1f} ms vs fused "
+              f"{fused_ms:.1f} ms -> {batch_speedups[q]:.2f}x",
+              file=sys.stderr)
+    if batch_speedups[4] < 1.5:
+        bad.append(f"fused batch of 4 only {batch_speedups[4]:.2f}x over "
+                   f"serial; gate is 1.5x")
+    fuse_ratio = batchq / batchn if batchn else 0.0
+
+    # ---- leg 3: delta-merge A/B at Δ/N = 1/16, 1/64, 1/256
+    def delta_arm(budget, ratio, tag):
+        svc = ServiceConfig(resident_budget_bytes=budget,
+                            default_deadline_s=300.0)
+        m3 = Measurements(node_id=0, num_nodes=8)
+        s3 = JoinSession(cfg, svc, measurements=m3)
+        nt = 1 << 14
+        try:
+            lats, outs = [], []
+            for i in range(4):
+                s3.submit(QueryRequest(
+                    query_id=f"{tag}{i}", tuples_per_node=nt,
+                    delta_tuples_per_node=max(1, nt // ratio), seed=11))
+                out = s3.run_next()
+                outs.append(out)
+                lats.append(out.latency_ms)
+            for o in outs:
+                exact(o, f"delta-1/{ratio}-"
+                         f"{'resident' if budget else 'full'}")
+            if budget:
+                hot = [o.served_by for o in outs[1:]]
+                if hot != ["delta_merge"] * 3:
+                    bad.append(f"resident arm 1/{ratio} not on the delta "
+                               f"path: {hot}")
+            # query 0 is the cold seed in BOTH arms; steady state is q1..3
+            return statistics.mean(lats[1:]), m3
+        finally:
+            s3.close()
+
+    delta_speedups = {}
+    deltamerge = resbytes = 0
+    for ratio in (16, 64, 256):
+        # warm pass compiles the per-shape programs (process-global
+        # lru_cache in ops/merge_delta.py), so the timed pass prices
+        # serving, not tracing
+        delta_arm(1 << 27, ratio, f"dwr{ratio}_")
+        delta_arm(0, ratio, f"dwf{ratio}_")
+        hot_ms, mr = delta_arm(1 << 27, ratio, f"dr{ratio}_")
+        cold_ms_d, _ = delta_arm(0, ratio, f"df{ratio}_")
+        deltamerge += int(mr.counters.get(DELTAMERGE, 0))
+        resbytes = max(resbytes, int(mr.counters.get(RESBYTES, 0)))
+        delta_speedups[ratio] = cold_ms_d / max(hot_ms, 1e-9)
+        print(f"note: delta 1/{ratio}: resident {hot_ms:.1f} ms vs full "
+              f"re-sort {cold_ms_d:.1f} ms -> "
+              f"{delta_speedups[ratio]:.2f}x", file=sys.stderr)
+    if delta_speedups[64] < 2.0:
+        bad.append(f"delta merge at 1/64 only {delta_speedups[64]:.2f}x "
+                   f"over the full re-sort; gate is 2x")
+
+    # ---- leg 4: mid-batch worker kill must not break exactly-once
+    tmp = tempfile.mkdtemp(prefix="serve_tp_bench_")
+    tpn_c = 1 << 10
+    worker_args = ["--nodes", "1", "--verify", "check",
+                   "--batch-window-ms", "25", "--batch-max", "8"]
+    mF = Measurements()
+    sup = FleetSupervisor(2, worker_args, os.path.join(tmp, "chaos"),
+                          measurements=mF, lease_s=1.0,
+                          batch_window_ms=25.0)
+    double_exec = -1
+    try:
+        sup.start()
+        warm = sup.dispatch({"query_id": "cw", "tenant": "t0",
+                             "tuples_per_node": tpn_c, "seed": 7})
+        if not (warm.get("status") == "ok"
+                and warm.get("matches") == tpn_c):
+            bad.append(f"fleet warm-up not oracle-exact: {warm.get('status')} "
+                       f"matches={warm.get('matches')}")
+        group = [{"query_id": f"c{i}", "tenant": "t0",
+                  "tuples_per_node": tpn_c, "seed": 7 + i}
+                 for i in range(4)]
+        # the kill site fires per written query (1-based): the routed
+        # worker dies right after the first write, mid-group — the
+        # unanswered remainder must fail over under its journaled
+        # fingerprints
+        with faults.FaultInjector(seed=13, measurements=mF).arm(
+                faults.FLEET_WORKER_KILL, at=1):
+            outs = sup.dispatch_batch(group)
+        for o in outs:
+            if not (o.get("status") == "ok"
+                    and o.get("matches") == tpn_c):
+                bad.append(f"mid-batch kill lost {o.get('query_id')}: "
+                           f"{o.get('status')} "
+                           f"matches={o.get('matches')} != {tpn_c} "
+                           f"({o.get('detail')})")
+        report = sup.drain()
+        double_exec = report["double_exec"]
+        if report["unacked"] or report["double_exec"]:
+            bad.append(f"mid-batch kill broke exactly-once at drain: "
+                       f"{report}")
+        if int(mF.counters.get(FAILOVER, 0)) < 1:
+            bad.append("mid-batch kill never failed over — the chaos "
+                       "site did not fire (armed at write 1)")
+        print(f"note: mid-batch kill: 4/4 exact through failover, "
+              f"restarts={sup.restarts}, drain={report}", file=sys.stderr)
+    finally:
+        sup.close()
+
+    if bad:
+        for b in bad:
+            print(f"ERROR: {b}", file=sys.stderr)
+        return 3
+
+    print(f"note: cache {cache_speedup:.0f}x (cold {cold_ms:.1f} ms -> "
+          f"hit {hit_ms:.3f} ms), batch {batch_speedups[4]:.2f}x at q=4, "
+          f"delta {delta_speedups[64]:.2f}x at 1/64", file=sys.stderr)
+    result = {
+        "metric": "serve_fastpath_speedup",
+        "value": round(batch_speedups[4], 3),
+        "unit": "serial_over_fused_wall_q4",
+        "cache_cold_latency_ms": round(cold_ms, 3),
+        "cache_hit_latency_ms": round(hit_ms, 4),
+        "cache_speedup": round(cache_speedup, 1),
+        "cache_hit_rate": cache_stats["hit_rate"],
+        "batch_speedup_2": round(batch_speedups[2], 3),
+        "batch_speedup_4": round(batch_speedups[4], 3),
+        "batch_speedup_8": round(batch_speedups[8], 3),
+        "batch_fuse_ratio": round(fuse_ratio, 3),
+        "delta_speedup_16": round(delta_speedups[16], 3),
+        "delta_speedup_64": round(delta_speedups[64], 3),
+        "delta_speedup_256": round(delta_speedups[256], 3),
+        "delta_speedup": round(delta_speedups[64], 3),
+        "rchit": int(meas.counters.get(RCHIT, 0)),
+        "rcmiss": int(meas.counters.get(RCMISS, 0)),
+        "batchn": batchn,
+        "batchq": batchq,
+        "deltamerge": deltamerge,
+        "resbytes": resbytes,
+        "statusz_polls": polls,
+        "double_exec": double_exec,
+    }
+    print(json.dumps(result))
+    _ledger_append(result)
+    if check_baseline:
+        from tpu_radix_join.observability.regress import check_result
+        code, report = check_result(result, check_baseline)
+        print(report, file=sys.stderr)
+        return code
+    return 0
+
+
 def _run_critpath_bench(check_baseline=None, size=1 << 20, iters=5):
     """``--critpath-bench``: instrumentation-overhead A/B for the
     critical-path attribution plane (observability/critpath.py +
@@ -1602,6 +1915,13 @@ def main():
         # mid-query failover against the cold supervisor restart and the
         # journal's exactly-once drain audit, not chip throughput
         sys.exit(_run_fleet_bench(check_baseline))
+    if "--serve-throughput-bench" in argv:
+        # serving fast-path A/B (service/resultcache.py + microbatch.py +
+        # resident.py + ops/merge_delta.py): CPU-sized like
+        # --chaos/--serve-bench — it gates the cache/batch/delta speedups,
+        # the mid-batch-kill exactly-once audit, and statusz liveness,
+        # not chip throughput
+        sys.exit(_run_serve_throughput_bench(check_baseline))
     if "--serve-bench" in argv:
         # resident-service amortization bench (service/session.py):
         # CPU-sized like --chaos/--grid-bench — it gates warm-query reuse

@@ -144,7 +144,7 @@ def test_v3_profile_roundtrips_with_provenance(tmp_path):
     path = str(tmp_path / "p.json")
     prof.save(path)
     back = load_profile(path)
-    assert back.schema_version == 5
+    assert back.schema_version == 6
     prov = back.provenance("sort_stage_unit_ms")
     assert prov["origin"] == "fit" and prov["n"] == 2
     assert prov["runs"] == ["b0", "b1"]
@@ -156,15 +156,16 @@ def test_v3_profile_roundtrips_with_provenance(tmp_path):
 
 
 def test_v1_shim_and_committed_still_load(tmp_path):
-    committed = load_profile("v5e_lite")          # the checked-in v5
-    assert committed.schema_version == 5
+    committed = load_profile("v5e_lite")          # the checked-in v6
+    assert committed.schema_version == 6
     assert committed.freshness() is None          # no provenance: never fit
     v1 = {"schema_version": 1, "name": "old",
           "constants": {k: dict(committed.constants[k])
                         for k in committed.constants
                         if k not in ("ici_bytes_per_s",
                                      "partition_pass_unit_ms",
-                                     "radix_sort_pass_unit_ms")}}
+                                     "radix_sort_pass_unit_ms",
+                                     "result_cache_lookup_ms")}}
     path = str(tmp_path / "v1.json")
     with open(path, "w") as f:
         json.dump(v1, f)
@@ -178,6 +179,10 @@ def test_v1_shim_and_committed_still_load(tmp_path):
     assert back.value("radix_sort_pass_unit_ms") == pytest.approx(
         12.0 / committed.value("hbm_gbps"), rel=1e-3)
     assert back.source("radix_sort_pass_unit_ms").startswith("shim:")
+    # v6 shim: the result-cache probe derives from the dispatch floor
+    assert back.value("result_cache_lookup_ms") == pytest.approx(
+        committed.value("dispatch_floor_ms") / 10.0, rel=1e-3)
+    assert back.source("result_cache_lookup_ms").startswith("shim:")
 
 
 def test_v3_profile_shims_partition_unit(tmp_path):
@@ -264,9 +269,9 @@ def test_profile_fit_cli_fit_and_diff(tmp_path):
         led.append("bench", _bench_row(0.3, rid=f"b{i}"))
     out = _cli("tools_profile_fit.py", "fit", "--ledger", str(tmp_path))
     assert out.returncode == 0, out.stderr
-    assert "fitted 1/11 constants" in out.stdout
+    assert "fitted 1/12 constants" in out.stdout
     fitted = str(tmp_path / FITTED_PROFILE_BASENAME)
-    assert load_profile(fitted).schema_version == 5
+    assert load_profile(fitted).schema_version == 6
     # 0.3 vs committed 0.147 is > 25% -> diff gates
     out = _cli("tools_profile_fit.py", "diff", "v5e_lite", fitted)
     assert out.returncode == 1

@@ -1,0 +1,398 @@
+"""Serving fast-path tests: content-fingerprint result cache, the
+micro-batch coalescer and its fused device program, the resident
+delta-merge tier with the unchanged-outer incremental probe, request
+canonicalization properties, and the serving-tier cost-model rows.
+
+The engine-integrated cases ride the conftest 8-device virtual CPU mesh
+like tests/test_serve.py; the unit cases (cache, coalescer, resident
+manager, merge ops) run device-light against numpy oracles.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_radix_join.core.config import JoinConfig, ServiceConfig
+from tpu_radix_join.performance.measurements import (DELTAMERGE, RCHIT,
+                                                     RCMISS, RESBYTES,
+                                                     Measurements)
+from tpu_radix_join.robustness import faults
+from tpu_radix_join.service import JoinSession, QueryRequest
+from tpu_radix_join.service.journal import request_fingerprint
+from tpu_radix_join.service.microbatch import MicroBatcher, batch_signature
+from tpu_radix_join.service.resident import ResidentStateManager
+from tpu_radix_join.service.resultcache import ResultCache, content_fingerprint
+
+NODES = 8
+TPN = 1 << 8
+
+
+def _req(qid, **kw):
+    kw.setdefault("tuples_per_node", TPN)
+    kw.setdefault("seed", 7)
+    return QueryRequest(query_id=qid, **kw)
+
+
+# -------------------------------------------------- fingerprint properties
+
+def test_request_fingerprint_key_order_and_float_folding():
+    a = {"query_id": "q", "tuples_per_node": 1024, "seed": 2}
+    b = {"seed": 2.0, "query_id": "q", "tuples_per_node": 1024.0}
+    assert request_fingerprint(a) == request_fingerprint(b)
+
+
+def test_request_fingerprint_drops_nonsemantic_envelope():
+    base = {"query_id": "q", "tuples_per_node": 1024}
+    assert (request_fingerprint(base)
+            == request_fingerprint({**base, "deadline_s": 5.0}))
+    # query_id IS semantic for the submission fingerprint
+    assert (request_fingerprint(base)
+            != request_fingerprint({**base, "query_id": "other"}))
+
+
+def test_request_fingerprint_bool_is_not_int():
+    # bool is an int subclass; canonicalization must keep them distinct
+    a = {"query_id": "q", "flag": True}
+    b = {"query_id": "q", "flag": 1}
+    assert request_fingerprint(a) != request_fingerprint(b)
+
+
+def test_content_fingerprint_ignores_submission_envelope():
+    r1 = _req("q1", tenant="a", deadline_s=1.0)
+    r2 = _req("q2", tenant="b", deadline_s=9.0)
+    assert content_fingerprint(r1) == content_fingerprint(r2)
+    assert content_fingerprint(r1) != content_fingerprint(
+        _req("q1", seed=8))
+
+
+def test_content_fingerprint_epoch_and_config_are_identity():
+    r = _req("q")
+    assert (content_fingerprint(r, epoch=1)
+            != content_fingerprint(r, epoch=2))
+    assert (content_fingerprint(r, config_fp={"nodes": 8})
+            != content_fingerprint(r, config_fp={"nodes": 4}))
+
+
+# ------------------------------------------------------- result cache unit
+
+def _payload(matches=100):
+    return {"matches": matches, "expected": matches, "engine": "primary"}
+
+
+def test_result_cache_hit_miss_and_lru():
+    cache = ResultCache(2)
+    assert cache.get("a") is None               # cold miss
+    cache.put("a", _payload(1))
+    cache.put("b", _payload(2))
+    assert cache.get("a")["matches"] == 1
+    cache.put("c", _payload(3))                 # evicts b (a was touched)
+    assert cache.get("b") is None
+    assert cache.get("a") is not None and cache.get("c") is not None
+    stats = cache.stats()
+    assert stats["entries"] == 2 and stats["hits"] == 3
+
+
+def test_result_cache_ttl_expiry_fake_clock():
+    now = [0.0]
+    cache = ResultCache(4, ttl_s=10.0, clock=lambda: now[0])
+    cache.put("a", _payload())
+    now[0] = 9.0
+    assert cache.get("a") is not None
+    now[0] = 20.1
+    assert cache.get("a") is None
+    assert cache.expired == 1
+
+
+def test_result_cache_epoch_mismatch_drops():
+    cache = ResultCache(4)
+    cache.put("a", _payload(), epoch=1)
+    assert cache.get("a", epoch=2) is None      # dropped, not served
+    assert cache.dropped_stale == 1
+    assert cache.get("a", epoch=1) is None      # really gone
+
+
+def test_result_cache_poison_digest_drop():
+    m = Measurements()
+    cache = ResultCache(4, measurements=m)
+    cache.put("a", _payload(42))
+    with faults.FaultInjector(seed=1, measurements=m).arm(
+            faults.CACHE_POISON, at=1):
+        assert cache.get("a") is None           # corrupted -> miss
+    assert cache.dropped_stale == 1
+    assert int(m.counters.get(RCMISS, 0)) == 1
+    assert int(m.counters.get(RCHIT, 0)) == 0
+
+
+def test_result_cache_disabled_posture():
+    cache = ResultCache(0)
+    cache.put("a", _payload())
+    assert cache.get("a") is None
+    assert cache.hits == cache.misses == 0      # disabled gets don't count
+
+
+# --------------------------------------------------------- merge ops units
+
+def test_merge_sorted_matches_numpy_with_duplicates():
+    from tpu_radix_join.ops.merge_delta import merge_sorted
+    import jax.numpy as jnp
+    rng = np.random.default_rng(3)
+    for n, d in [(0, 5), (5, 0), (1, 1), (1000, 37), (512, 512)]:
+        a = np.sort(rng.integers(0, 300, n).astype(np.uint32))
+        b = np.sort(rng.integers(0, 300, d).astype(np.uint32))
+        got = np.asarray(merge_sorted(jnp.asarray(a), jnp.asarray(b)))
+        assert np.array_equal(got, np.sort(np.concatenate([a, b])))
+
+
+def test_delta_merge_count_and_increment_agree():
+    from tpu_radix_join.ops.merge_delta import (delta_merge_count,
+                                                delta_merge_increment)
+    import jax.numpy as jnp
+    rng = np.random.default_rng(5)
+    base = rng.permutation(np.arange(4096, dtype=np.uint32))
+    delta = np.arange(4096, 4096 + 256, dtype=np.uint32)
+    s = rng.integers(0, 5000, 2048).astype(np.uint32)
+    lane = jnp.asarray(np.sort(base))
+    union, total = delta_merge_count(lane, jnp.asarray(delta),
+                                     jnp.asarray(s))
+    want = int(np.isin(s, np.concatenate([base, delta])).sum())
+    assert int(total) == want
+    assert np.array_equal(np.asarray(union),
+                          np.sort(np.concatenate([base, delta])))
+    # additive counting: prior total + increment == the full recount
+    prior = int(np.isin(s, base).sum())
+    union2, inc = delta_merge_increment(lane, jnp.asarray(delta),
+                                        jnp.asarray(np.sort(s)))
+    assert prior + int(inc) == want
+    assert np.array_equal(np.asarray(union2), np.asarray(union))
+
+
+def test_batched_merge_count_matches_per_query():
+    from tpu_radix_join.ops.merge_delta import batched_merge_count
+    import jax.numpy as jnp
+    rng = np.random.default_rng(9)
+    key_bound = 1 << 10
+    r_parts = [rng.integers(0, key_bound, n).astype(np.uint32)
+               for n in (128, 256, 64)]
+    s_parts = [rng.integers(0, key_bound, n).astype(np.uint32)
+               for n in (200, 100, 300)]
+    counts = batched_merge_count(
+        jnp.asarray(np.concatenate(r_parts)),
+        jnp.asarray(np.concatenate(s_parts)),
+        tuple(len(p) for p in r_parts), tuple(len(p) for p in s_parts),
+        key_bound)
+    for i, (r, s) in enumerate(zip(r_parts, s_parts)):
+        want = sum(int((r == k).sum()) for k in s)
+        assert int(counts[i]) == want, f"query {i}"
+
+
+def test_batch_feasible_bounds():
+    from tpu_radix_join.ops.merge_delta import (MAX_SERVE_KEY,
+                                                batch_feasible,
+                                                composite_shift)
+    assert batch_feasible(8, 1 << 20)
+    assert not batch_feasible(2, MAX_SERVE_KEY)      # shift >= 32
+    assert not batch_feasible(1 << 12, 1 << 20)      # tag overflows
+    with pytest.raises(ValueError):
+        composite_shift(0)
+
+
+# -------------------------------------------------- resident state manager
+
+class _Lane:
+    def __init__(self, nbytes):
+        self.nbytes = nbytes
+
+
+def test_resident_budget_eviction_lru_and_gauge():
+    m = Measurements()
+    res = ResidentStateManager(100, measurements=m)
+    assert res.put("a", _Lane(40))
+    assert res.put("b", _Lane(40))
+    assert res.get("a") is not None              # a becomes MRU
+    assert res.put("c", _Lane(40))               # evicts b
+    assert res.get("b") is None and res.get("a") is not None
+    assert res.evicted == 1 and res.resident_bytes == 80
+    assert int(m.counters.get(RESBYTES, 0)) == 80   # high-water held
+    assert not res.put("huge", _Lane(1000))      # larger than the budget
+    assert res.rejected == 1
+
+
+def test_resident_epoch_mismatch_drops_lane():
+    res = ResidentStateManager(100)
+    res.put("a", _Lane(10), epoch=1)
+    assert res.get("a", epoch=2) is None
+    assert len(res) == 0
+
+
+def test_resident_disabled_budget_zero():
+    res = ResidentStateManager(0)
+    assert not res.put("a", _Lane(1))
+    assert res.get("a") is None
+
+
+# ------------------------------------------------------ micro-batch window
+
+def test_microbatcher_disabled_and_infeasible_serve_solo():
+    mb = MicroBatcher(0.0, max_queries=4)
+    assert mb.offer(_req("q0"), key_bound=TPN * NODES) == [_req("q0")]
+    mb2 = MicroBatcher(50.0, max_queries=4)
+    from tpu_radix_join.ops.merge_delta import MAX_SERVE_KEY
+    assert len(mb2.offer(_req("q1"), key_bound=MAX_SERVE_KEY)) == 1
+
+
+def test_microbatcher_parks_until_window_then_due():
+    now = [0.0]
+    mb = MicroBatcher(50.0, max_queries=8, clock=lambda: now[0])
+    assert mb.offer(_req("a"), key_bound=1 << 12) is None
+    assert mb.offer(_req("b"), key_bound=1 << 12) is None
+    assert mb.due() == []                        # window still open
+    now[0] = 0.051
+    groups = mb.due()
+    assert [len(g) for g in groups] == [2]
+    assert mb.stats()["fused_batches"] == 1
+
+
+def test_microbatcher_full_window_flushes_immediately():
+    mb = MicroBatcher(1000.0, max_queries=2)
+    assert mb.offer(_req("a"), key_bound=1 << 12) is None
+    group = mb.offer(_req("b"), key_bound=1 << 12)
+    assert group is not None and len(group) == 2
+    assert mb.pending() == 0
+
+
+def test_microbatcher_tight_deadline_serves_solo():
+    mb = MicroBatcher(50.0, max_queries=8)
+    out = mb.offer(_req("a", deadline_s=0.01), key_bound=1 << 12)
+    assert out is not None and len(out) == 1     # window > deadline
+
+
+def test_microbatcher_signature_separates_windows():
+    now = [0.0]
+    mb = MicroBatcher(50.0, max_queries=8, clock=lambda: now[0])
+    mb.offer(_req("a"), key_bound=1 << 12)
+    mb.offer(_req("b", outer_kind="modulo", modulo=16), key_bound=1 << 12)
+    assert mb.pending() == 2
+    groups = mb.flush()
+    assert [len(g) for g in groups] == [1, 1]
+    assert (batch_signature(groups[0][0])
+            != batch_signature(groups[1][0]))
+
+
+# --------------------------------------------- admission queue group pull
+
+def test_pop_matching_preserves_order_and_limit():
+    from tpu_radix_join.service.admission import AdmissionQueue
+    q = AdmissionQueue()
+    for i in range(5):
+        q.submit(_req(f"q{i}", seed=7 if i % 2 == 0 else 8))
+    first = q.pop()
+    assert first.query_id == "q0"
+    peers = q.pop_matching(lambda r: r.seed == 7, 8)
+    assert [r.query_id for r in peers] == ["q2", "q4"]
+    rest = [q.pop().query_id for _ in range(2)]
+    assert rest == ["q1", "q3"]                 # relative order survives
+
+
+# ------------------------------------------------- serving-tier cost rows
+
+def test_serving_strategy_rows():
+    from tpu_radix_join.planner import (ServingContext,
+                                        enumerate_serving_strategies,
+                                        load_profile)
+    from tpu_radix_join.planner.cost_model import Workload
+    prof = load_profile()
+    w = Workload(r_tuples=1 << 20, s_tuples=1 << 20, key_bound=1 << 20,
+                 num_nodes=8)
+    rows = {c.strategy: c for c in enumerate_serving_strategies(
+        prof, w, ServingContext(batch_queries=4, delta_tuples=1 << 14,
+                                resident=True))}
+    assert rows["serve_cached"].feasible is False    # delta never caches
+    assert rows["serve_batched"].feasible
+    assert rows["serve_delta"].feasible
+    cached = enumerate_serving_strategies(
+        prof, w, ServingContext())[0]
+    assert cached.strategy == "serve_cached" and cached.feasible
+    assert cached.cost_ms == pytest.approx(
+        prof.value("result_cache_lookup_ms"))
+
+
+# ------------------------------------------------ engine-integrated tiers
+
+def test_session_cache_hit_stamps_and_exactness():
+    cfg = JoinConfig(num_nodes=NODES)
+    svc = ServiceConfig(result_cache_max=4)
+    m = Measurements(node_id=0, num_nodes=NODES)
+    sess = JoinSession(cfg, svc, measurements=m)
+    try:
+        sess.submit(_req("cold"))
+        cold = sess.run_next()
+        assert cold.status == "ok" and cold.matches == cold.expected
+        assert sess.try_cache(_req("miss", seed=99)) is None
+        hit = sess.try_cache(_req("hot"))
+        assert hit is not None and hit.served_by == "cache_hit"
+        assert hit.query_id == "hot"             # envelope re-stamped
+        assert hit.matches == cold.matches
+        assert int(m.counters.get(RCHIT, 0)) == 1
+    finally:
+        sess.close()
+
+
+def test_session_batched_drain_fuses_cosignature_queries():
+    cfg = JoinConfig(num_nodes=NODES)
+    svc = ServiceConfig(batch_window_ms=50.0, batch_max_queries=8)
+    sess = JoinSession(cfg, svc)
+    try:
+        for i in range(3):
+            sess.submit(_req(f"b{i}"))
+        sess.submit(_req("solo", outer_kind="modulo", modulo=16))
+        outs = {o.query_id: o for o in sess.drain()}
+        assert all(o.status == "ok" and o.matches == o.expected
+                   for o in outs.values())
+        assert [outs[f"b{i}"].served_by for i in range(3)] == ["batched"] * 3
+        assert outs["solo"].served_by == "execute"
+        assert sess.batches_fused == 1 and sess.batch_queries_fused == 3
+    finally:
+        sess.close()
+
+
+def test_session_delta_chain_incremental_and_eviction_reset():
+    cfg = JoinConfig(num_nodes=NODES)
+    svc = ServiceConfig(resident_budget_bytes=1 << 24)
+    m = Measurements(node_id=0, num_nodes=NODES)
+    sess = JoinSession(cfg, svc, measurements=m)
+    try:
+        outs = []
+        for i in range(3):
+            sess.submit(_req(f"d{i}", delta_tuples_per_node=32))
+            outs.append(sess.run_next())
+        assert all(o.status == "ok" and o.matches == o.expected
+                   for o in outs)
+        assert outs[0].served_by == "execute"    # cold seed
+        assert [o.served_by for o in outs[1:]] == ["delta_merge"] * 2
+        # the union grows by 32 * NODES matched keys per absorbed delta
+        assert outs[1].matches == outs[0].matches
+        assert int(m.counters.get(DELTAMERGE, 0)) == 2
+        # eviction mid-chain: residency lost -> cold rebuild, still exact
+        sess.resident.invalidate()
+        sess.submit(_req("d3", delta_tuples_per_node=32))
+        o3 = sess.run_next()
+        assert o3.status == "ok" and o3.matches == o3.expected
+        assert o3.served_by == "execute"
+        sess.submit(_req("d4", delta_tuples_per_node=32))
+        o4 = sess.run_next()
+        assert o4.served_by == "delta_merge"
+        assert o4.status == "ok" and o4.matches == o4.expected
+    finally:
+        sess.close()
+
+
+def test_session_delta_budget_zero_stays_on_full_path():
+    cfg = JoinConfig(num_nodes=NODES)
+    sess = JoinSession(cfg, ServiceConfig())     # residency disabled
+    try:
+        for i in range(2):
+            sess.submit(_req(f"d{i}", delta_tuples_per_node=32))
+            out = sess.run_next()
+            assert out.status == "ok" and out.matches == out.expected
+            assert out.served_by == "execute"
+    finally:
+        sess.close()

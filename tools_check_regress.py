@@ -136,6 +136,36 @@ invariant, and because its baseline is 0 any growth is an infinite
 relative delta: a single double execution fails this gate at every
 threshold, no ``--allow`` precedent.
 
+A ``--serve-throughput-bench`` BENCH json gates the serving fast-path
+A/B (service/resultcache.py result cache, service/microbatch.py +
+ops/merge_delta.py fused micro-batches, service/resident.py delta
+merges):
+
+    {"metric": "serve_fastpath_speedup", "value": 5.92,
+     "unit": "serial_over_fused_wall_q4",
+     "cache_cold_latency_ms": 441.1, "cache_hit_latency_ms": 0.14,
+     "cache_speedup": 3088.0, "cache_hit_rate": 0.33,
+     "batch_speedup_2": 4.1, "batch_speedup_4": 5.9,
+     "batch_speedup_8": 6.6, "batch_fuse_ratio": 4.67,
+     "delta_speedup_16": 6.9, "delta_speedup_64": 8.3,
+     "delta_speedup_256": 8.2, "delta_speedup": 8.3,
+     "rchit": 1, "rcmiss": 2, "batchn": 6, "batchq": 28,
+     "deltamerge": 9, "resbytes": 1179648, "statusz_polls": 5,
+     "double_exec": 0}
+
+The headline ``value`` is the Q=4 fused-over-serial wall speedup
+(higher is better), and every ``*_speedup`` plus ``cache_hit_rate`` and
+``batch_fuse_ratio`` gate higher-is-better — a fast path that stops
+firing shows up as a collapsed ratio before it shows up as latency.
+``rchit`` / ``deltamerge`` are pinned higher-is-better (fewer
+whole-query amortization wins at the same traffic means a tier went
+dark) while ``rcmiss`` is a cost; ``batchn`` / ``batchq`` /
+``resbytes`` / ``statusz_polls`` are declared neutral (traffic- and
+budget-shaped descriptors whose gated observables are the ratios).
+``double_exec`` rides the --fleet-bench zero pin: the bench's
+mid-batch ``fleet.worker_kill`` leg must keep the journal exactly-once
+even while a fused group dies on a worker's pipe.
+
 The ``--recovery-bench --grow`` arm gates mid-run admission vs fixed
 survivors (rank admission re-expanding the assignment map):
 

@@ -409,6 +409,34 @@ class ServiceConfig:
                                      # so a week-long worker must not grow
                                      # this list with every query served
 
+    # --- placed-relation LRU (service/session.py) ------------------------
+    place_cache_max: int = 8         # device-resident placed-batch entries;
+                                     # the HBM bound on input reuse (was the
+                                     # hard-coded _PLACE_CACHE_MAX)
+
+    # --- result cache (service/resultcache.py) ---------------------------
+    # Content-fingerprint result cache: a repeated query on unchanged
+    # inputs short-circuits before admission.  0 disables (the default —
+    # turning whole-result reuse on is an operator decision, not a silent
+    # behavior change); entries expire after result_cache_ttl_s (None =
+    # no TTL) and invalidate on spec/epoch/config change via the content
+    # fingerprint itself.
+    result_cache_max: int = 0
+    result_cache_ttl_s: Optional[float] = None
+
+    # --- inter-query micro-batching (service/microbatch.py) --------------
+    # Bounded window coalescer: small same-shape joins arriving within
+    # batch_window_ms fuse into ONE device program (composite-key batched
+    # count).  0.0 disables; batch_max_queries bounds one fused batch.
+    batch_window_ms: float = 0.0
+    batch_max_queries: int = 8
+
+    # --- incremental delta-merge joins (service/resident.py) -------------
+    # Explicit HBM budget for device-resident sorted unions kept across
+    # queries (O(N+Δ) serving: sort only the per-query delta, merge into
+    # the resident state, binary-search probe).  0 disables.
+    resident_budget_bytes: int = 0
+
     def __post_init__(self):
         if self.max_queue_depth < 1:
             raise ValueError("max_queue_depth must be >= 1")
@@ -423,6 +451,21 @@ class ServiceConfig:
             raise ValueError("breaker_cooldown_s must be >= 0")
         if self.outcomes_keep < 1:
             raise ValueError("outcomes_keep must be >= 1")
+        if self.place_cache_max < 0:
+            raise ValueError("place_cache_max must be >= 0 (0 = no reuse)")
+        if self.result_cache_max < 0:
+            raise ValueError("result_cache_max must be >= 0 (0 = disabled)")
+        if (self.result_cache_ttl_s is not None
+                and self.result_cache_ttl_s <= 0):
+            raise ValueError("result_cache_ttl_s must be > 0 (or None)")
+        if self.batch_window_ms < 0:
+            raise ValueError("batch_window_ms must be >= 0 (0 = disabled)")
+        if self.batch_max_queries < 2:
+            raise ValueError("batch_max_queries must be >= 2 (a batch of "
+                             "one is the serial path)")
+        if self.resident_budget_bytes < 0:
+            raise ValueError(
+                "resident_budget_bytes must be >= 0 (0 = disabled)")
 
     def replace(self, **kw) -> "ServiceConfig":
         return dataclasses.replace(self, **kw)

@@ -240,6 +240,38 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serve mode: default per-query latency budget "
                         "(requests may override with their own deadline_s; "
                         "expiry -> deadline_exceeded)")
+    p.add_argument("--result-cache", type=int, default=0, metavar="N",
+                   help="serve/fleet mode: relation-fingerprint result "
+                        "cache of N entries (service/resultcache.py) — "
+                        "repeated queries over unchanged relation content "
+                        "short-circuit before admission, stamped "
+                        "served_by=cache_hit (default 0 = off)")
+    p.add_argument("--result-cache-ttl-s", type=float, default=None,
+                   metavar="SEC",
+                   help="serve/fleet mode: expire result-cache entries "
+                        "older than SEC (default: no TTL)")
+    p.add_argument("--batch-window-ms", type=float, default=0.0,
+                   metavar="MS",
+                   help="serve/fleet mode: coalesce co-batchable queries "
+                        "arriving within MS into ONE fused device program "
+                        "(service/microbatch.py + ops/merge_delta.py); the "
+                        "fleet router additionally keys on the batch "
+                        "signature so co-batchable tenants share a worker "
+                        "(default 0 = off)")
+    p.add_argument("--batch-max", type=int, default=8, metavar="N",
+                   help="serve/fleet mode: max queries fused into one "
+                        "micro-batch (default 8)")
+    p.add_argument("--place-cache-max", type=int, default=8, metavar="N",
+                   help="serve mode: placed-relation LRU entries kept "
+                        "device-resident per session (default 8; placed "
+                        "bytes surface in heartbeats and --statusz)")
+    p.add_argument("--resident-budget-mb", type=float, default=0.0,
+                   metavar="MB",
+                   help="serve mode: HBM budget for device-resident sorted "
+                        "inner lanes (service/resident.py) — incremental "
+                        "requests (delta_tuples_per_node > 0) then sort "
+                        "only their delta and merge in O(N+Δ), stamped "
+                        "served_by=delta_merge (default 0 = off)")
     p.add_argument("--fleet", type=int, default=None, metavar="N",
                    help="crash-only fleet serving (service/fleet.py): "
                         "supervise N --serve worker subprocesses, route "
@@ -623,7 +655,7 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
 
     from tpu_radix_join.core.config import ServiceConfig
     from tpu_radix_join.service import (AdmissionRejected, JoinSession,
-                                        QueryRequest)
+                                        MicroBatcher, QueryRequest)
 
     plan_cache = None
     if args.plan_cache_dir:
@@ -646,7 +678,13 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         tenant_quota=args.serve_tenant_quota,
         default_deadline_s=args.serve_deadline_s,
         breaker_threshold=args.breaker_threshold,
-        breaker_cooldown_s=args.breaker_cooldown_s)
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        place_cache_max=args.place_cache_max,
+        result_cache_max=args.result_cache,
+        result_cache_ttl_s=args.result_cache_ttl_s,
+        batch_window_ms=args.batch_window_ms,
+        batch_max_queries=args.batch_max,
+        resident_budget_bytes=int(args.resident_budget_mb * (1 << 20)))
     ledger = None
     ld = _ledger_dir(args)
     if ld:
@@ -660,6 +698,9 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
                           elastic_grow=args.elastic_grow,
                           hedge=args.hedge,
                           hedge_threshold=args.hedge_threshold)
+    # the coalescer is owned by the serve loop (no threads of its own):
+    # offer() as requests arrive, due() before blocking, flush() at EOF
+    batcher = MicroBatcher(svc.batch_window_ms, svc.batch_max_queries)
     # fleet workers are spawned with an incarnation id (w<slot>i<n>,
     # service/fleet.py); stamping it into the flight-recorder context
     # makes every forensics bundle this worker writes group per
@@ -702,6 +743,16 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
             "wasted": int(meas.counters.get(SPECWASTE, 0))})
         sections["critical_paths"] = (
             lambda: list(session.recent_critical_paths))
+        if svc.result_cache_max or svc.resident_budget_bytes:
+            sections["cache"] = (lambda: {
+                "result_cache": session.result_cache.stats(),
+                "resident": session.resident.stats(),
+                "placed_bytes": session.placed_bytes()})
+        if svc.batch_window_ms > 0:
+            sections["batch"] = (lambda: {
+                **batcher.stats(),
+                "session_fused_batches": session.batches_fused,
+                "session_fused_queries": session.batch_queries_fused})
 
         def _readiness():
             # /healthz readiness: closed session, open breaker, or a
@@ -728,19 +779,69 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
         print(f"[STATUSZ] serving http://127.0.0.1:{statusz.port}"
               "/statusz", file=sys.stderr)
 
+    errors = 0
+    fuse = svc.batch_window_ms > 0
+
+    def emit(out):
+        print(_json.dumps({"event": "outcome", **out.to_json()}), flush=True)
+
+    def flush_groups(groups):
+        # submit every member of every due group back-to-back, then drain:
+        # contiguous co-signature queries fuse inside run_next_batch
+        submitted = 0
+        for group in groups:
+            for request in group:
+                try:
+                    session.submit(request)
+                    submitted += 1
+                except AdmissionRejected as e:
+                    emit(session.rejection_outcome(request, e))
+        if submitted:
+            session.drain(on_outcome=emit)
+
     if args.serve == "-":
         # stream, don't slurp: a resident session answers requests as
         # they arrive on the pipe (an operator can hold stdin open and
         # poll --statusz between queries); EOF still ends the session
-        lines = iter(sys.stdin)
+        if fuse:
+            # reader thread + timed queue: a parked micro-batch group
+            # must flush when its window expires even if stdin goes
+            # quiet — a blocking readline would strand it forever (the
+            # fleet supervisor's dispatch_batch awaits those outcomes)
+            import queue as _queue
+            import threading as _threading
+
+            lineq: "_queue.Queue" = _queue.Queue()
+
+            def _read_lines():
+                try:
+                    for raw in sys.stdin:
+                        lineq.put(raw)
+                finally:
+                    lineq.put(None)
+
+            _threading.Thread(target=_read_lines, name="serve-stdin",
+                              daemon=True).start()
+
+            def _timed_lines():
+                while True:
+                    nd = batcher.next_deadline_s()
+                    wait = 0.2 if nd is None else max(0.001, min(0.2, nd))
+                    try:
+                        raw = lineq.get(timeout=wait)
+                    except _queue.Empty:
+                        flush_groups(batcher.due())
+                        continue
+                    if raw is None:
+                        return
+                    yield raw
+
+            lines = _timed_lines()
+        else:
+            lines = iter(sys.stdin)
     else:
         with open(args.serve) as f:
             lines = f.read().splitlines()
-
-    errors = 0
-
-    def emit(out):
-        print(_json.dumps({"event": "outcome", **out.to_json()}), flush=True)
 
     batch = max(1, args.serve_batch)
     try:
@@ -766,6 +867,22 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
                                    "error": str(e)}),
                       flush=True)
                 continue
+            # tier 0: a result-cache hit answers before admission — it
+            # never occupies a queue slot or a tenant quota
+            hit = session.try_cache(request)
+            if hit is not None:
+                emit(hit)
+                continue
+            if fuse and request.delta_tuples_per_node == 0:
+                # park in the signature window; key bound = the widest
+                # key any generated lane can carry for this request
+                key_bound = max(request.tuples_per_node * cfg.num_nodes,
+                                request.modulo or 0)
+                group = batcher.offer(request, key_bound)
+                if group is not None:
+                    flush_groups([group])
+                flush_groups(batcher.due())
+                continue
             try:
                 session.submit(request)
                 pending += 1
@@ -774,6 +891,8 @@ def _run_serve(args, cfg, meas, nodes, sampler=None, membership=None) -> int:
             if pending >= batch:
                 session.drain(on_outcome=emit)
                 pending = 0
+        if fuse:
+            flush_groups(batcher.flush())
         session.drain(on_outcome=emit)
         summary = session.summary()
         print(_json.dumps({"event": "summary", **summary}), flush=True)
@@ -830,15 +949,32 @@ def _run_fleet(args) -> int:
                     "--breaker-threshold", str(args.breaker_threshold),
                     "--breaker-cooldown-s", str(args.breaker_cooldown_s),
                     "--serve-queue-depth", str(args.serve_queue_depth),
-                    "--serve-tenant-quota", str(args.serve_tenant_quota)]
+                    "--serve-tenant-quota", str(args.serve_tenant_quota),
+                    "--place-cache-max", str(args.place_cache_max)]
     if args.serve_deadline_s is not None:
         worker_args += ["--serve-deadline-s", str(args.serve_deadline_s)]
+    if args.result_cache:
+        worker_args += ["--result-cache", str(args.result_cache)]
+        if args.result_cache_ttl_s is not None:
+            worker_args += ["--result-cache-ttl-s",
+                            str(args.result_cache_ttl_s)]
+    if args.batch_window_ms > 0:
+        # the workers MUST share the batch window: dispatch_batch writes a
+        # fused group's request lines back-to-back, and it is the worker's
+        # own coalescer that turns them into one device program
+        worker_args += ["--batch-window-ms", str(args.batch_window_ms),
+                        "--batch-max", str(args.batch_max)]
+    if args.resident_budget_mb:
+        worker_args += ["--resident-budget-mb", str(args.resident_budget_mb)]
 
     meas = Measurements()
     sup = FleetSupervisor(args.fleet, worker_args, work_dir,
                           measurements=meas,
                           lease_s=args.rank_lease_s,
-                          missed_beats=args.rank_missed_beats)
+                          missed_beats=args.rank_missed_beats,
+                          result_cache_max=args.result_cache,
+                          result_cache_ttl_s=args.result_cache_ttl_s,
+                          batch_window_ms=args.batch_window_ms)
 
     statusz = None
     if args.statusz is not None:
@@ -905,11 +1041,32 @@ def _run_fleet(args) -> int:
                       f"intent(s) from {sup.journal.path}",
                       file=sys.stderr)
             reader.start()
+            # supervisor-side micro-batch windows: co-signature requests
+            # arriving within --batch-window-ms dispatch together via
+            # dispatch_batch (one signature-routed worker, back-to-back
+            # lines, the worker fuses them into one device program)
+            import time as _time
+            window_s = args.batch_window_ms / 1000.0
+            parked: dict = {}          # sig -> (opened_monotonic, [obj])
+
+            def _flush_sig(sig):
+                _, group = parked.pop(sig)
+                for out in sup.dispatch_batch(group):
+                    emit(out)
+
+            def _flush_due():
+                now = _time.monotonic()
+                for sig in sorted(parked, key=lambda s: parked[s][0]):
+                    if now - parked[sig][0] >= window_s:
+                        _flush_sig(sig)
+
+            poll_s = min(0.2, window_s) if window_s > 0 else 0.2
             lineno = 0
             while not stop.is_set():
                 try:
-                    line = lineq.get(timeout=0.2)
+                    line = lineq.get(timeout=poll_s if parked else 0.2)
                 except _queue.Empty:
+                    _flush_due()
                     continue
                 if line is None:
                     break
@@ -928,7 +1085,20 @@ def _run_fleet(args) -> int:
                                        "line": lineno, "error": str(e)}),
                           flush=True)
                     continue
-                emit(sup.dispatch(obj))
+                sig = sup._batch_signature(obj)
+                if sig is None or obj.get("delta_tuples_per_node"):
+                    emit(sup.dispatch(obj))
+                else:
+                    opened, group = parked.get(sig,
+                                               (_time.monotonic(), []))
+                    group.append(obj)
+                    parked[sig] = (opened, group)
+                    if len(group) >= args.batch_max:
+                        _flush_sig(sig)
+                _flush_due()
+            # EOF / SIGTERM: no parked query is ever lost to the drain
+            for sig in list(parked):
+                _flush_sig(sig)
         report = sup.drain()
         summary = {**sup.summary(), "drain": report}
         print(_json.dumps({"event": "summary", **summary}, default=str),

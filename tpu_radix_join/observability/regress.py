@@ -39,7 +39,20 @@ _HIGHER_BETTER = {"value", "vs_baseline",
                   "HEDGEWIN",
                   # lowercase twin for the --recovery-bench --straggle
                   # artifact key (fence wins per hedge round)
-                  "hedgewin"}
+                  "hedgewin",
+                  # serving fast paths (--serve-throughput-bench): result-
+                  # cache hits and delta-merge serves are whole-query
+                  # amortization wins — fewer at the same traffic means a
+                  # fast path silently stopped firing
+                  "RCHIT", "DELTAMERGE",
+                  # lowercase twins for the --serve-throughput-bench
+                  # artifact keys (same counters, JSON-cased)
+                  "rchit", "deltamerge",
+                  # queries per fused micro-batch (BATCHQ / BATCHN): a
+                  # falling fuse ratio means the window coalescer is
+                  # dispatching per-query programs again.  Pinned exactly
+                  # because "ratio" is not a direction substring.
+                  "batch_fuse_ratio"}
 _HIGHER_BETTER_SUBSTRINGS = ("rate", "gbps", "throughput", "tuples/sec",
                              "tuples_per_sec", "per_sec", "pairs/sec",
                              "speedup",
@@ -174,7 +187,13 @@ _COST_TAGS = {"JTOTAL", "JPROC", "JHIST", "JMPI", "JCOMPILE", "SWINALLOC",
               # may be right every time and it is still a fleet-health
               # regression); SPECWASTE also rides the lower-is-better
               # substring for the bench artifact keys
-              "HEDGED", "SPECWASTE"}
+              "HEDGED", "SPECWASTE",
+              # result-cache misses (cold content, TTL expiry, digest or
+              # epoch drop): more misses at the same traffic means the
+              # content fingerprint stopped deduping equal work
+              "RCMISS",
+              # lowercase twin for the --serve-throughput-bench artifact key
+              "rcmiss"}
 # Explicitly neutral tags: workload/geometry descriptors with no
 # regression direction (tuple counts scale with the input, capacities
 # and stage counts describe the plan, chaos/checkpoint counters describe
@@ -189,7 +208,19 @@ NEUTRAL_TAGS = {"RTUPLES", "STUPLES", "RESULTS",
                 "STATICMEM",
                 # admissions describe the scenario (a grow arm admits by
                 # design); losses regress, joins don't
-                "RANKJOIN", "rankjoin"}
+                "RANKJOIN", "rankjoin",
+                # micro-batch shape descriptors: batches formed and queries
+                # batched scale with traffic — the gated observable is the
+                # fuse ratio (batch_fuse_ratio, pinned higher-better)
+                "BATCHN", "BATCHQ", "batchn", "batchq",
+                # liveness polls answered during a bench run: a scenario
+                # count (the bench gates that every poll answered)
+                "statusz_polls",
+                # resident sorted-union bytes: a gauge bounded by the
+                # operator's resident_budget_bytes — more resident state
+                # is neither win nor loss by itself (the delta_speedup it
+                # buys is the gated observable)
+                "RESBYTES", "resbytes"}
 # bookkeeping fields that are not measurements at all
 _SKIP = {"n", "rc", "probe_attempts", "wait_budget_s", "size", "iters",
          "schema_version",

@@ -1,0 +1,218 @@
+"""Serving fast-path device programs: O(N+Δ) delta merges and fused
+multi-query batched counts.
+
+Two primitives back the serving fast paths (service/resident.py and
+service/microbatch.py), both built on the presorted binary-search probe
+discipline of :func:`~tpu_radix_join.ops.merge_count.merge_count_presorted`:
+
+  * **Delta merge** — a session keeps each relation's sorted key lane
+    device-resident; an incremental query sorts only its Δ new keys and
+    :func:`merge_sorted` splices them into the resident union with one
+    Δ-sided ``searchsorted``, a marker cumsum, and a monotone gather
+    (O(N+Δ) streaming data movement, no O(N log N) re-sort).  The probe
+    binary-searches the merged union exactly like the grid's presorted
+    probe when the outer changes (:func:`delta_merge_count`); when the
+    outer is UNCHANGED, :func:`delta_merge_increment` probes only the Δ
+    against the session's resident sorted outer lane and the running
+    total absorbs the increment — multiset counts are additive, so the
+    shared M·log N full-lane probe drops off the hot path entirely.
+
+  * **Batched count** — the micro-batch coalescer concatenates several
+    small queries' key lanes, tags each element with its query index in
+    the bits ABOVE the key bound (the composite-key trick of
+    ``ops/radix.py scatter_to_blocks_grouped``: ``dest * num_sub + sub``
+    under one sort), and ONE sort + ONE probe serves the whole batch;
+    per-query counts split back out of a cumulative-sum of the per-outer
+    weights at the (static) query boundaries — the same boundary
+    discipline ``merge_count_per_partition_full`` uses for per-partition
+    counts.
+
+Key-range contract: like every presorted-probe path, real keys must stay
+below the sentinel range (``<= 0xFFFFFFFD``); the batched composite
+additionally needs ``num_queries << shift`` to fit uint32
+(:func:`batch_feasible`), where ``shift = ceil(log2(key_bound))``.
+Infeasible batches are the coalescer's problem — it executes them
+serially instead (service/microbatch.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: exclusive ceiling real keys must stay under for the presorted probe
+#: (tuples.py sentinel discipline — 0xFFFFFFFE/0xFFFFFFFF are pads)
+MAX_SERVE_KEY = 0xFFFFFFFD
+
+
+def composite_shift(key_bound: int) -> int:
+    """Bits the query tag must shift past: ``ceil(log2(key_bound))`` so
+    ``(qid << shift) | key`` is injective over (qid, key)."""
+    if key_bound < 1:
+        raise ValueError("key_bound must be >= 1")
+    return max(1, math.ceil(math.log2(max(2, key_bound))))
+
+
+def batch_feasible(num_queries: int, key_bound: int) -> bool:
+    """True when ``num_queries`` queries with keys < ``key_bound`` fit the
+    uint32 composite word below the sentinel range — the coalescer's
+    fuse/serial decision."""
+    shift = composite_shift(key_bound)
+    if shift >= 32:
+        return False
+    top = (num_queries << shift) - 1
+    return top <= MAX_SERVE_KEY
+
+
+def merge_sorted(a_sorted: jnp.ndarray, b_sorted: jnp.ndarray) -> jnp.ndarray:
+    """Merge two ALREADY-SORTED uint32 lanes in O(N+Δ) with the work on
+    the Δ side: only the SMALL lane is binary-searched into the big one
+    (Δ·log N), then the big lane's slots fall out of a marker cumsum —
+    for an unmarked slot ``j``, ``prefix[j]`` counts the b-elements
+    placed before it, so it holds ``a[j - prefix[j]]`` (a monotone,
+    coalesced gather).  The earlier formulation searchsorted the BIG
+    lane into the small one (N·log Δ random gathers), which profiling
+    showed costs as much as the full re-sort it was meant to replace;
+    marker + cumsum + monotone gather are genuine streaming passes.
+    ``side="right"`` tie-breaks a-before-b so the merge is stable across
+    the seam."""
+    n, d = a_sorted.shape[0], b_sorted.shape[0]
+    if d == 0:
+        return a_sorted
+    if n == 0:
+        return b_sorted
+    pos_b = (jnp.arange(d, dtype=jnp.int32)
+             + jnp.searchsorted(a_sorted, b_sorted,
+                                side="right").astype(jnp.int32))
+    marker = jnp.zeros(n + d, dtype=jnp.int32).at[pos_b].set(
+        1, unique_indices=True)
+    prefix = jnp.cumsum(marker)
+    idx = jnp.arange(n + d, dtype=jnp.int32) - prefix
+    out = a_sorted[jnp.clip(idx, 0, n - 1)]
+    out = out.at[pos_b].set(b_sorted, unique_indices=True)
+    return out
+
+
+def delta_merge_count(resident_sorted: jnp.ndarray,
+                      delta_keys: jnp.ndarray,
+                      outer_keys: jnp.ndarray
+                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One incremental query as a single traceable program: sort ONLY the
+    Δ delta keys, merge them into the resident sorted union, probe the
+    outer lane against the merged union with the two-binary-search weight
+    rule.  Returns ``(new_resident_sorted, total_matches)`` — the caller
+    (service/resident.py) keeps ``new_resident_sorted`` on device for the
+    next delta."""
+    from tpu_radix_join.ops.merge_count import merge_count_presorted
+    from tpu_radix_join.ops.sorting import sort_unstable
+
+    delta_sorted = sort_unstable(delta_keys)
+    union = merge_sorted(resident_sorted, delta_sorted)
+    total = merge_count_presorted(union, outer_keys)
+    return union, total
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_delta_merge_count(n_resident: int, n_delta: int, n_outer: int):
+    """Jitted :func:`delta_merge_count` for one (N, Δ, M) shape class —
+    the session's per-shape compile cache (an LRU so a long-lived worker
+    cannot grow an unbounded executable set)."""
+    del n_resident, n_delta, n_outer   # shape key only; jit re-specializes
+    return jax.jit(delta_merge_count)
+
+
+def delta_merge_increment(resident_sorted: jnp.ndarray,
+                          delta_keys: jnp.ndarray,
+                          outer_sorted: jnp.ndarray
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One incremental query whose OUTER is unchanged since the last
+    query on this relation: sort the Δ, splice it into the resident
+    union, and count only the Δ's matches against the session's resident
+    SORTED outer lane — ``total = previous_total + increment`` is exact
+    for multiset counts because ``count(s, A ⊎ Δ) = count(s, A) +
+    count(s, Δ)``.  This keeps the whole hot query O(N+Δ): the full-lane
+    probe (M·log N random gathers, as costly as the re-sort it rides on)
+    is paid only when the outer actually changes
+    (:func:`delta_merge_count`).  Returns ``(new_resident_sorted,
+    increment)``."""
+    from tpu_radix_join.ops.sorting import sort_unstable
+
+    delta_sorted = sort_unstable(delta_keys)
+    union = merge_sorted(resident_sorted, delta_sorted)
+    lb = jnp.searchsorted(outer_sorted, delta_sorted, side="left")
+    ub = jnp.searchsorted(outer_sorted, delta_sorted, side="right")
+    inc = jnp.sum((ub - lb).astype(jnp.uint32))
+    return union, inc
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_delta_merge_increment(n_resident: int, n_delta: int,
+                                   n_outer: int):
+    """Jitted :func:`delta_merge_increment` for one (N, Δ, M) shape class
+    (same per-shape compile-cache discipline as
+    :func:`compiled_delta_merge_count`)."""
+    del n_resident, n_delta, n_outer   # shape key only; jit re-specializes
+    return jax.jit(delta_merge_increment)
+
+
+def batched_merge_count(r_keys: jnp.ndarray, s_keys: jnp.ndarray,
+                        r_sizes: Tuple[int, ...], s_sizes: Tuple[int, ...],
+                        key_bound: int) -> jnp.ndarray:
+    """Fused multi-query count: ONE sort + ONE probe over the
+    concatenated per-query lanes.
+
+    ``r_keys``/``s_keys`` are the queries' inner/outer key lanes
+    concatenated in query order; ``r_sizes``/``s_sizes`` are the static
+    per-query lengths.  Each element is tagged with its query index above
+    the key bits (``(qid << shift) | key``), so one unstable sort groups
+    the whole batch by query with keys ordered within each group — the
+    ``scatter_to_blocks_grouped`` composite trick at serving scope.  The
+    probe's per-outer weights can never cross a query boundary (the tag
+    bits differ), and the per-query totals fall out of one cumulative sum
+    read at the static query offsets (the
+    ``merge_count_per_partition_full`` boundary idiom, minus the
+    searchsorted: concatenation order makes the boundaries static).
+
+    Returns the uint32 per-query match counts, shape ``[num_queries]``.
+    Caller must have checked :func:`batch_feasible`.
+    """
+    from tpu_radix_join.ops.sorting import sort_unstable
+
+    q = len(r_sizes)
+    if q != len(s_sizes):
+        raise ValueError(f"r_sizes/s_sizes disagree: {q} != {len(s_sizes)}")
+    if not batch_feasible(q, key_bound):
+        raise ValueError(
+            f"{q} queries at key_bound {key_bound} overflow the uint32 "
+            f"composite (shift {composite_shift(key_bound)})")
+    shift = jnp.uint32(composite_shift(key_bound))
+    import numpy as np
+    r_qid = jnp.asarray(np.repeat(np.arange(q, dtype=np.uint32),
+                                  np.asarray(r_sizes)))
+    s_qid = jnp.asarray(np.repeat(np.arange(q, dtype=np.uint32),
+                                  np.asarray(s_sizes)))
+    rc = (r_qid << shift) | r_keys
+    sc = (s_qid << shift) | s_keys
+    rc_sorted = sort_unstable(rc)
+    lb = jnp.searchsorted(rc_sorted, sc, side="left").astype(jnp.uint32)
+    ub = jnp.searchsorted(rc_sorted, sc, side="right").astype(jnp.uint32)
+    csum = jnp.concatenate([
+        jnp.zeros(1, jnp.uint32),
+        jnp.cumsum(ub - lb, dtype=jnp.uint32)])
+    bounds = np.concatenate([[0], np.cumsum(np.asarray(s_sizes))])
+    return csum[jnp.asarray(bounds[1:])] - csum[jnp.asarray(bounds[:-1])]
+
+
+@functools.lru_cache(maxsize=64)
+def compiled_batched_merge_count(r_sizes: Tuple[int, ...],
+                                 s_sizes: Tuple[int, ...], key_bound: int):
+    """Jitted :func:`batched_merge_count` for one batch shape class (the
+    static sizes and key bound are closed over, so the whole batch is one
+    compiled device program)."""
+    fn = functools.partial(batched_merge_count, r_sizes=r_sizes,
+                           s_sizes=s_sizes, key_bound=key_bound)
+    return jax.jit(lambda r, s: fn(r, s))

@@ -160,6 +160,26 @@ WRESTART = "WRESTART"      # dead-worker restarts (WINCARN minus the boot pool)
 JDEPTH = "JDEPTH"          # gauge: peak unacknowledged query-journal depth
 DOUBLEEXEC = "DOUBLEEXEC"  # fingerprints with >1 journaled outcome — the
                            # exactly-once invariant; any nonzero is a bug
+RCHIT = "RCHIT"            # result-cache hits: queries short-circuited by a
+                           # content-fingerprint match before admission
+                           # (service/resultcache.py); the whole-result
+                           # amortization win — fewer at the same traffic
+                           # means repeated work stopped deduping
+RCMISS = "RCMISS"          # result-cache misses (cold content, TTL expiry,
+                           # or a digest/epoch check dropping a stale entry)
+BATCHN = "BATCHN"          # fused micro-batches dispatched as ONE device
+                           # program (service/microbatch.py); scenario-
+                           # shaped — the fuse ratio BATCHQ/BATCHN is the
+                           # gated observable, not the raw count
+BATCHQ = "BATCHQ"          # queries served through fused micro-batches
+                           # (each batch of k ticks this k times)
+DELTAMERGE = "DELTAMERGE"  # queries served O(N+Δ): delta sorted + merged
+                           # into the device-resident sorted union instead
+                           # of re-sorting the full relation
+                           # (service/resident.py + ops/merge_delta.py)
+RESBYTES = "RESBYTES"      # gauge: device-resident sorted-union bytes held
+                           # by the resident-state manager (bounded by
+                           # ServiceConfig.resident_budget_bytes)
 JRATE = "JRATE"            # derived: (R+S) tuples / JTOTAL second
 JPROCRATE = "JPROCRATE"    # derived: (R+S) tuples / JPROC second
 HILOCRATE = "HILOCRATE"    # derived: inner tuples / JHIST second

@@ -32,7 +32,9 @@ from tpu_radix_join.planner.audit import (actuals_for_explain, audit_plan,
 from tpu_radix_join.planner.cache import PlanCache
 from tpu_radix_join.planner.calibrate import (UnderSampledError, detect_stale,
                                               diff_profiles, fit_profile)
-from tpu_radix_join.planner.cost_model import StrategyCost, Workload
+from tpu_radix_join.planner.cost_model import (ServingContext, StrategyCost,
+                                               Workload,
+                                               enumerate_serving_strategies)
 from tpu_radix_join.planner.plan import (JoinPlan, PlanError,
                                          PlanInfeasibleError, explain_table,
                                          plan_join, static_memory_gate)
@@ -42,9 +44,10 @@ from tpu_radix_join.planner.profile import (DeviceProfile, calibrate,
 
 __all__ = [
     "DeviceProfile", "JoinPlan", "PlanCache", "PlanError",
-    "PlanInfeasibleError", "StrategyCost",
+    "PlanInfeasibleError", "ServingContext", "StrategyCost",
     "UnderSampledError", "Workload", "actuals_for_explain", "audit_plan",
-    "calibrate", "detect_stale", "diff_profiles", "explain_table",
+    "calibrate", "detect_stale", "diff_profiles",
+    "enumerate_serving_strategies", "explain_table",
     "fit_profile", "format_provenance", "load_profile", "phase_snapshot",
     "plan_join", "resolve_profile", "static_memory_gate",
 ]

@@ -50,7 +50,13 @@ from typing import Dict, Optional
 # are priced separately from hbm_gbps).  v1-v4 profiles load through a
 # shim deriving it as 12/hbm_gbps; calibrate.py re-fits it from
 # ``--sort-bench`` ledger rows with provenance.
-SCHEMA_VERSION = 5
+# v6 adds ``result_cache_lookup_ms`` — the host-side price of one
+# fingerprint + LRU probe of the serving result cache
+# (service/resultcache.py; the planner's serve_cached strategy row is
+# this constant alone).  v1-v5 profiles load through a shim deriving it
+# as dispatch_floor_ms / 10 — a pure-host hash lookup is at least an
+# order of magnitude under one device round trip.
+SCHEMA_VERSION = 6
 
 #: Constants the cost model reads.  Adding a term to cost_model.py means
 #: adding its constant here AND to every shipped profile, with a source tag
@@ -96,6 +102,13 @@ REQUIRED_CONSTANTS = (
     # lane in both phases and writes 4 B of slots).  calibrate.py fits it
     # from --sort-bench ledger rows (sort_kernel_ms / passes / Mtuples).
     "radix_sort_pass_unit_ms",
+    # serving result cache: ms per fingerprint + LRU probe on the host
+    # (service/resultcache.py — sha256 over the canonical request spec
+    # plus one OrderedDict move-to-end; no device work at all).  The
+    # serve_cached strategy row is this constant alone, which is what
+    # makes the planner prefer it over every execution arm.  Schema v6;
+    # v1-v5 profiles are shimmed to dispatch_floor_ms / 10 at load.
+    "result_cache_lookup_ms",
 )
 
 #: Reference element count of the sort stage model's unit (PERF_NOTES
@@ -253,6 +266,19 @@ def load_profile(name_or_path: str = "v5e_lite") -> DeviceProfile:
                 constants["radix_sort_pass_unit_ms"] = {
                     "value": round(12.0 / float(entry["value"]), 5),
                     "source": ("shim:derived from hbm_gbps "
+                               f"(schema v{version} profile; "
+                               f"{entry.get('source', 'uncited')})")}
+        if version < 6 and "result_cache_lookup_ms" not in constants:
+            # schema v1-v5 shim: the serve_cached strategy row (schema v6)
+            # reads result_cache_lookup_ms; derive it from the cited
+            # dispatch_floor_ms — a host-side hash probe touches no device,
+            # so a tenth of the dispatch round trip is a conservative
+            # ceiling (the measured v5e_lite value is far smaller still).
+            entry = constants.get("dispatch_floor_ms")
+            if isinstance(entry, dict) and entry.get("value"):
+                constants["result_cache_lookup_ms"] = {
+                    "value": round(float(entry["value"]) / 10.0, 5),
+                    "source": ("shim:derived from dispatch_floor_ms "
                                f"(schema v{version} profile; "
                                f"{entry.get('source', 'uncited')})")}
         return DeviceProfile(

@@ -437,11 +437,15 @@ def soak_recovery(runs: int, base_seed: int = 0,
 #: sites a resident serve loop consults per query: the per-query dispatch
 #: outage (service/session.py) plus the engine-interior sites join_arrays
 #: hits — a session soak exercises breaker trips and engine failures in
-#: the same stream
+#: the same stream.  serve.cache_poison corrupts a stored result-cache
+#: entry in place (service/resultcache.py); the digest re-verification
+#: must drop it and re-execute, so a poisoned cache can cause a miss but
+#: never a silent wrong count.
 SESSION_SITES: Tuple[str, ...] = (
     faults.BACKEND_DISPATCH,
     faults.SHUFFLE_OVERFLOW,
     faults.EXCHANGE_CORRUPT,
+    faults.CACHE_POISON,
 )
 
 
@@ -486,8 +490,12 @@ class SessionChaosRunner:
         self.data_seed = data_seed
         self.config = JoinConfig(num_nodes=num_nodes, verify=verify,
                                  **(config_overrides or {}))
+        # the result cache is LIVE in the soak (every query shares one
+        # content fingerprint, so queries 2..N are cache hits) — that is
+        # what gives the serve.cache_poison arm a stored entry to corrupt
         self.service = ServiceConfig(breaker_threshold=1,
-                                     breaker_cooldown_s=0.0)
+                                     breaker_cooldown_s=0.0,
+                                     result_cache_max=4)
         self.measurements: List[Any] = []   # one registry per run, in order
 
     def run(self, schedule: Schedule) -> RunOutcome:
@@ -511,9 +519,13 @@ class SessionChaosRunner:
         try:
             with inj:
                 for i in range(self.queries):
+                    # cycle 3 distinct contents: the first lap of the
+                    # stream executes (misses), later laps hit the result
+                    # cache — so engine-interior arms and the cache-poison
+                    # arm both get live consultations in one stream
                     request = QueryRequest(
                         query_id=f"q{i}", tuples_per_node=self.size,
-                        seed=self.data_seed)
+                        seed=self.data_seed + (i % 3))
                     session.submit(request)
                     outs.append(session.run_next())
         except Exception as e:      # noqa: BLE001 — the invariant itself
@@ -641,15 +653,24 @@ class FleetChaosRunner:
     survives the stream**.  An escaped exception, an unclassified
     outcome, a silent wrong count, or ``double_exec > 0`` is a
     VIOLATION.
+
+    ``batched=True`` dispatches each run's queries as ONE co-batchable
+    group through ``dispatch_batch`` (the supervisor must have a batch
+    window armed) — the worker-kill site then fires between the group's
+    back-to-back request writes, i.e. MID-BATCH, and the invariant holds
+    that failover re-dispatches the stranded members without a single
+    double-execution.
     """
 
     def __init__(self, supervisor, queries: int = 3, size: int = 1 << 10,
-                 data_seed: int = 0, bundle_dir: Optional[str] = None):
+                 data_seed: int = 0, bundle_dir: Optional[str] = None,
+                 batched: bool = False):
         self.supervisor = supervisor
         self.queries = queries
         self.size = size
         self.data_seed = data_seed
         self.bundle_dir = bundle_dir
+        self.batched = batched
         self.measurements: List[Any] = []
 
     def run(self, schedule: Schedule) -> RunOutcome:
@@ -672,15 +693,21 @@ class FleetChaosRunner:
         outs = []
         try:
             with inj:
-                for i in range(self.queries):
-                    # seed-qualified ids keep fingerprints distinct across
-                    # runs — the journal dedup must only collapse genuine
-                    # re-submissions, not the soak's fresh queries
-                    request = {"query_id": f"s{schedule.seed}q{i}",
-                               "tenant": f"t{i % 2}",
-                               "tuples_per_node": self.size,
-                               "seed": self.data_seed}
-                    outs.append(sup.dispatch(request))
+                # seed-qualified ids keep fingerprints distinct across
+                # runs — the journal dedup must only collapse genuine
+                # re-submissions, not the soak's fresh queries
+                requests = [{"query_id": f"s{schedule.seed}q{i}",
+                             "tenant": f"t{i % 2}",
+                             "tuples_per_node": self.size,
+                             "seed": self.data_seed}
+                            for i in range(self.queries)]
+                if self.batched:
+                    # one co-batchable group through dispatch_batch: the
+                    # kill arm lands between the group's request writes
+                    outs = sup.dispatch_batch(requests)
+                else:
+                    for request in requests:
+                        outs.append(sup.dispatch(request))
         except Exception as e:      # noqa: BLE001 — the invariant itself
             return RunOutcome(schedule, VIOLATION, None, None,
                               f"supervisor died at query {len(outs)}: {e!r}")

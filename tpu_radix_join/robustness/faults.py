@@ -78,11 +78,18 @@ FLEET_WORKER_KILL = "fleet.worker_kill"        # SIGKILL a fleet worker right
                                                # replay the query on a healthy
                                                # worker, exactly one outcome
                                                # (service/fleet.py dispatch)
+CACHE_POISON = "serve.cache_poison"            # corrupt a stored result-cache
+                                               # entry in place: the digest/
+                                               # epoch re-check on read must
+                                               # drop it (count a miss, re-
+                                               # execute) — a stale or damaged
+                                               # entry is NEVER served
+                                               # (service/resultcache.py)
 
 SITES = (SHUFFLE_OVERFLOW, DEVICE_INIT, COORD_CONNECT, GRID_KILL,
          GRID_TRANSIENT, STREAM_CORRUPT, EXCHANGE_CORRUPT, CKPT_SAVE,
          CKPT_LOAD, BACKEND_DISPATCH, BACKEND_STALL, RANK_DEATH,
-         RANK_JOIN, COMPUTE_STRAGGLE, FLEET_WORKER_KILL)
+         RANK_JOIN, COMPUTE_STRAGGLE, FLEET_WORKER_KILL, CACHE_POISON)
 
 
 class InjectedFault(RuntimeError):

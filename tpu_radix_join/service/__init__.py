@@ -18,7 +18,13 @@ Public surface:
   * :class:`FleetSupervisor` — crash-only supervision of N serve
     workers: consistent-hash routing, heartbeat health checks, backoff
     restarts, crash-loop quarantine, journal replay, graceful drain
-    (fleet.py).
+    (fleet.py);
+  * :class:`ResultCache` / :func:`content_fingerprint` — whole-query
+    reuse keyed by relation content (resultcache.py);
+  * :class:`MicroBatcher` / :func:`batch_signature` — bounded-window
+    inter-query coalescing into fused device programs (microbatch.py);
+  * :class:`ResidentStateManager` — HBM-budgeted device-resident sorted
+    unions behind the O(N+Δ) delta-merge path (resident.py).
 """
 
 from tpu_radix_join.service.admission import (AdmissionQueue,
@@ -30,6 +36,10 @@ from tpu_radix_join.service.fleet import (FleetSupervisor, ring_points,
                                           route_tenant)
 from tpu_radix_join.service.journal import (JournalAudit, QueryJournal,
                                             request_fingerprint)
+from tpu_radix_join.service.microbatch import MicroBatcher, batch_signature
+from tpu_radix_join.service.resident import ResidentStateManager
+from tpu_radix_join.service.resultcache import (ResultCache,
+                                                content_fingerprint)
 from tpu_radix_join.service.session import (BackendUnavailable, JoinSession,
                                             QueryOutcome, QueryRequest,
                                             UNCLASSIFIED)
@@ -43,5 +53,8 @@ __all__ = [
     "JournalAudit", "QueryJournal", "request_fingerprint",
     "JoinSession", "QueryRequest", "QueryOutcome", "BackendUnavailable",
     "UNCLASSIFIED",
+    "MicroBatcher", "batch_signature",
+    "ResidentStateManager",
+    "ResultCache", "content_fingerprint",
     "SLORecorder", "nearest_rank",
 ]

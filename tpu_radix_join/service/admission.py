@@ -118,6 +118,26 @@ class AdmissionQueue:
         with self._lock:
             return self._pending.popleft() if self._pending else None
 
+    def pop_matching(self, pred, limit: int) -> list:
+        """Up to ``limit`` pending requests satisfying ``pred``, removed
+        in FIFO order; non-matching requests keep their relative order.
+        The micro-batch coalescer's group-pull (service/session.py
+        ``run_next_batch``): tenant slots stay held until :meth:`done`,
+        exactly as with :meth:`pop`."""
+        if limit <= 0:
+            return []
+        taken: list = []
+        with self._lock:
+            keep = collections.deque()
+            while self._pending:
+                request = self._pending.popleft()
+                if len(taken) < limit and pred(request):
+                    taken.append(request)
+                else:
+                    keep.append(request)
+            self._pending = keep
+        return taken
+
     def done(self, request) -> None:
         """Release the tenant slot taken at submit (call exactly once per
         popped request, on every outcome path)."""

@@ -59,6 +59,9 @@ from tpu_radix_join.robustness.retry import (BACKEND_UNAVAILABLE,
                                              REQUEST_ERROR)
 from tpu_radix_join.service.breaker import OPEN, CircuitBreaker
 from tpu_radix_join.service.journal import QueryJournal, request_fingerprint
+from tpu_radix_join.service.microbatch import SIGNATURE_FIELDS
+from tpu_radix_join.service.resultcache import (ResultCache,
+                                                content_fingerprint)
 
 #: ring resolution: virtual nodes per worker slot — enough that losing
 #: one of a handful of workers re-hashes only its own tenants
@@ -183,7 +186,10 @@ class FleetSupervisor:
                  dispatch_timeout_s: float = 300.0,
                  python: Optional[str] = None,
                  env: Optional[dict] = None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 result_cache_max: int = 0,
+                 result_cache_ttl_s: Optional[float] = None,
+                 batch_window_ms: float = 0.0):
         if workers < 1:
             raise ValueError("fleet needs at least one worker")
         self.num_workers = workers
@@ -215,6 +221,20 @@ class FleetSupervisor:
                 measurements=measurements))
         self.draining = False
         self.started = False
+        #: supervisor-side result cache: a content hit is answered at the
+        #: supervisor, journaled intent+outcome under the submission's
+        #: fingerprint (exactly-once holds unchanged), and never reaches
+        #: a worker.  Keyed by content + the worker config (worker_args
+        #: determine what every worker computes).
+        self.result_cache = ResultCache(result_cache_max,
+                                        result_cache_ttl_s,
+                                        measurements=measurements)
+        #: when > 0 the router keys on the batch signature instead of the
+        #: tenant, so co-batchable queries from DIFFERENT tenants land on
+        #: the same worker and actually meet in its coalescing window
+        self.batch_window_ms = float(batch_window_ms)
+        #: tenant -> slot of the last routed query (statusz affinity view)
+        self.batch_affinity: Dict[str, int] = {}
         # counters mirrored locally so summary() works without a registry
         self.failovers = 0
         self.replays = 0
@@ -368,13 +388,30 @@ class FleetSupervisor:
             time.sleep(0.05)
 
     # -------------------------------------------------------------- routing
-    def pick_worker(self, tenant: str) -> Optional[_Worker]:
-        """The tenant's ring owner among live slots.  The load signal is
+    def _batch_signature(self, request: dict) -> Optional[str]:
+        """The request's co-batchability class as a ring key, or None when
+        batching is off — mirrors service/microbatch.batch_signature over
+        the wire dict (same fields, same defaults as QueryRequest)."""
+        if self.batch_window_ms <= 0:
+            return None
+        defaults = {"tuples_per_node": 1 << 16, "outer_kind": "unique",
+                    "modulo": None, "zipf_theta": 0.75, "repeats": 1}
+        sig = tuple(request.get(f, defaults[f]) for f in SIGNATURE_FIELDS)
+        return f"sig:{sig}"
+
+    def pick_worker(self, tenant: str,
+                    signature: Optional[str] = None) -> Optional[_Worker]:
+        """The tenant's ring owner among live slots — or, when a batch
+        ``signature`` is given (batching enabled), the SIGNATURE's ring
+        owner, so co-batchable queries from different tenants land on one
+        worker and meet in its coalescing window.  The load signal is
         deliberately coarse for a closed-loop dispatcher: ring ownership
-        keeps a tenant's warm caches on one worker; ledger/heartbeat load
+        keeps warm capacity caches on one worker; ledger/heartbeat load
         (queries served, lease age) surfaces in statusz for operators and
         re-balances only through membership changes."""
-        slot = route_tenant(tenant, self.routable_slots())
+        slot = route_tenant(signature or tenant, self.routable_slots())
+        if slot is not None:
+            self.batch_affinity[tenant] = slot
         return self.workers[slot] if slot is not None else None
 
     # ------------------------------------------------------------- dispatch
@@ -430,23 +467,30 @@ class FleetSupervisor:
                     deaths=w.deaths, backoff_s=round(w.backoff_s, 3),
                     quarantined=w.quarantined)
 
-    def dispatch(self, request: dict,
-                 replayed: bool = False) -> dict:
+    def dispatch(self, request: dict, replayed: bool = False,
+                 fp: Optional[str] = None) -> dict:
         """Serve one request exactly once; returns the outcome dict.
 
         The full WAL discipline: dedup against journaled outcomes first
         (a re-submitted or replayed query whose outcome exists is served
-        from the journal, never re-executed), then intent-journal,
-        dispatch, outcome-journal.  A worker death mid-query fails the
-        query over to a healthy worker (``FAILOVER`` + ``REPLAYN``); only
-        when every slot is down/quarantined past the dispatch deadline
-        does the query end as a *classified* failure — still exactly one
-        outcome."""
+        from the journal, never re-executed), then the supervisor-side
+        result cache (a content hit is journaled intent+outcome under the
+        submission fingerprint and answered without touching a worker),
+        then intent-journal, dispatch, outcome-journal.  A worker death
+        mid-query fails the query over to a healthy worker (``FAILOVER``
+        + ``REPLAYN``); only when every slot is down/quarantined past the
+        dispatch deadline does the query end as a *classified* failure —
+        still exactly one outcome.
+
+        ``fp`` overrides the computed submission fingerprint — the replay
+        path passes the journaled intent's fp verbatim so a replayed
+        query's outcome always lands under the intent it acknowledges,
+        even across builds whose canonicalization differs."""
         if self.draining:
             return self._classified_failure(request, "fleet draining: "
                                             "admission stopped")
         self.queries += 1
-        fp = request_fingerprint(request)
+        fp = fp or request_fingerprint(request)
         prior = self.journal.outcome_for(fp)
         if prior is not None:
             # journaled-outcome/lost-response dedup: the answer exists,
@@ -455,6 +499,9 @@ class FleetSupervisor:
             out = dict(prior)
             out["fleet"] = {"served_from_journal": True, "fp": fp}
             return out
+        cached = self._try_cache(request, fp)
+        if cached is not None:
+            return cached
         deadline = self._clock() + max(
             self.dispatch_timeout_s,
             float(request.get("deadline_s") or 0.0))
@@ -477,7 +524,8 @@ class FleetSupervisor:
                 self.journal.append_outcome(fp, out)
                 self._gauge_depth()
                 return out
-            w = self.pick_worker(request.get("tenant", "default"))
+            w = self.pick_worker(request.get("tenant", "default"),
+                                 signature=self._batch_signature(request))
             if w is None:
                 continue
             self.journal.append_intent(request, fp=fp, worker=w.slot,
@@ -509,12 +557,144 @@ class FleetSupervisor:
             w.breaker.record_success()
             w.backoff_s = 0.0
             self._gauge_depth()
+            self._cache_put(request, out)
             out = dict(out)
             out["fleet"] = {"worker": w.slot,
                             "incarnation": w.incarnation_id,
                             "attempts": attempt, "replayed": replayed
                             or attempt > 1}
             return out
+
+    def dispatch_batch(self, requests: List[dict]) -> List[dict]:
+        """Serve a co-batchable group through ONE worker: every request is
+        intent-journaled and written to the signature's ring owner
+        back-to-back — so the worker's serve loop sees the whole group
+        pending and coalesces it into a fused device program — then the
+        outcomes are awaited and journaled in order.  A worker death
+        mid-batch (the ``fleet.worker_kill`` chaos site fires per written
+        query) fails the UNANSWERED remainder over through the normal
+        one-query path under the same fingerprints — already-journaled
+        outcomes dedup, so every query still gets exactly one outcome and
+        ``double_exec`` stays 0."""
+        if len(requests) <= 1 or self.batch_window_ms <= 0:
+            return [self.dispatch(r) for r in requests]
+        m = self.measurements
+        outs: Dict[int, dict] = {}
+        pend: List[tuple] = []           # (index, request, fp) to execute
+        for i, request in enumerate(requests):
+            if self.draining:
+                outs[i] = self._classified_failure(
+                    request, "fleet draining: admission stopped")
+                continue
+            self.queries += 1
+            fp = request_fingerprint(request)
+            prior = self.journal.outcome_for(fp)
+            if prior is not None:
+                self.journal_served += 1
+                out = dict(prior)
+                out["fleet"] = {"served_from_journal": True, "fp": fp}
+                outs[i] = out
+                continue
+            cached = self._try_cache(request, fp)
+            if cached is not None:
+                outs[i] = cached
+                continue
+            pend.append((i, request, fp))
+        if pend:
+            deadline = self._clock() + self.dispatch_timeout_s
+            slot = self._ensure_capacity(deadline)
+            w = (self.pick_worker(
+                    pend[0][1].get("tenant", "default"),
+                    signature=self._batch_signature(pend[0][1]))
+                 if slot is not None else None)
+            alive = w is not None
+            if alive:
+                for i, request, fp in pend:
+                    self.journal.append_intent(request, fp=fp, worker=w.slot,
+                                               incarnation=w.incarnation_id,
+                                               attempt=1)
+                    try:
+                        w.proc.stdin.write(json.dumps(request) + "\n")
+                        w.proc.stdin.flush()
+                    except (OSError, ValueError):
+                        alive = False
+                        break
+                    if faults.fires(faults.FLEET_WORKER_KILL, m):
+                        self.kill_worker(w.slot)
+                self._gauge_depth()
+            died = not alive
+            for i, request, fp in pend:
+                out = (self._await_outcome(w, request, deadline)
+                       if not died else None)
+                if out is None:
+                    # worker lost mid-batch: the batch retries UNBATCHED —
+                    # each unanswered query fails over individually, its
+                    # journaled fp riding along so dedup and the audit
+                    # see one submission, one outcome
+                    if not died:
+                        died = True
+                        self._on_death(w, "died_mid_batch")
+                        self._count_failover(m)
+                    outs[i] = self.dispatch(request, replayed=True, fp=fp)
+                    continue
+                self.journal.append_outcome(fp, out, worker=w.slot)
+                w.queries_served += 1
+                w.breaker.record_success()
+                w.backoff_s = 0.0
+                self._cache_put(request, out)
+                out = dict(out)
+                out["fleet"] = {"worker": w.slot,
+                                "incarnation": w.incarnation_id,
+                                "attempts": 1, "replayed": False,
+                                "batched": len(pend)}
+                outs[i] = out
+            self._gauge_depth()
+        return [outs[i] for i in range(len(requests))]
+
+    # ---------------------------------------------------------- result cache
+    def _content_fp(self, request: dict) -> str:
+        # worker_args ARE the fleet's join config: every worker is spawned
+        # from them, so they are the config component of content identity
+        return content_fingerprint(request,
+                                   config_fp={"worker_args":
+                                              list(self.worker_args)})
+
+    def _try_cache(self, request: dict, fp: str) -> Optional[dict]:
+        """Answer ``request`` from the supervisor-side result cache, or
+        None.  A hit is journaled intent+outcome under the submission
+        fingerprint ``fp`` — the WAL sees the same accepted/answered pair
+        as an executed query, so replay, dedup, and the double_exec audit
+        are oblivious to where the answer came from."""
+        if self.result_cache.max_entries == 0:
+            return None
+        payload = self.result_cache.get(self._content_fp(request))
+        if payload is None:
+            return None
+        out = {"query_id": request.get("query_id"),
+               "tenant": request.get("tenant", "default"),
+               "status": "ok", "failure_class": "ok", "latency_ms": 0.0,
+               "matches": payload.get("matches"),
+               "expected": payload.get("expected"),
+               "engine": payload.get("engine", "primary"),
+               "degraded": False, "warm": True,
+               "breaker_state": "closed", "detail": "result cache hit",
+               "served_by": "cache_hit"}
+        self.journal.append_intent(request, fp=fp)
+        self.journal.append_outcome(fp, out)
+        out = dict(out)
+        out["fleet"] = {"served_from_cache": True, "fp": fp}
+        return out
+
+    def _cache_put(self, request: dict, out: dict) -> None:
+        if (self.result_cache.max_entries == 0
+                or out.get("status") != "ok" or out.get("degraded")
+                or out.get("matches") is None
+                or request.get("delta_tuples_per_node")):
+            return
+        self.result_cache.put(
+            self._content_fp(request),
+            {"matches": out.get("matches"), "expected": out.get("expected"),
+             "engine": out.get("engine", "primary")})
 
     def _count_failover(self, m) -> None:
         self.failovers += 1
@@ -568,7 +748,10 @@ class FleetSupervisor:
             self.replays += 1
             if m is not None:
                 m.incr(REPLAYN)
-            out = self.dispatch(request, replayed=True)
+            # the intent row's fp rides through verbatim: the replayed
+            # outcome must acknowledge THAT intent even if this build's
+            # canonicalization would fingerprint the request differently
+            out = self.dispatch(request, replayed=True, fp=row.get("fp"))
             outs.append(out)
             if emit:
                 emit(out)
@@ -657,18 +840,24 @@ class FleetSupervisor:
                 "breaker": w.breaker.snapshot(),
                 "queries_served": w.queries_served,
                 "lease_age_s": round(age, 3) if age is not None else None}
-        return {"workers": workers,
-                "routable": self.routable_slots(),
-                "draining": self.draining,
-                "journal": {"depth": audit.unacked,
-                            "peak_depth": self.peak_depth,
-                            "path": self.journal.path,
-                            **audit.to_json()},
-                "queries": self.queries,
-                "failovers": self.failovers,
-                "replays": self.replays,
-                "restarts": self.restarts,
-                "journal_served": self.journal_served}
+        out = {"workers": workers,
+               "routable": self.routable_slots(),
+               "draining": self.draining,
+               "journal": {"depth": audit.unacked,
+                           "peak_depth": self.peak_depth,
+                           "path": self.journal.path,
+                           **audit.to_json()},
+               "queries": self.queries,
+               "failovers": self.failovers,
+               "replays": self.replays,
+               "restarts": self.restarts,
+               "journal_served": self.journal_served}
+        if self.result_cache.max_entries:
+            out["cache"] = self.result_cache.stats()
+        if self.batch_window_ms > 0:
+            out["batch"] = {"window_ms": self.batch_window_ms,
+                            "affinity": dict(self.batch_affinity)}
+        return out
 
     def readiness(self) -> dict:
         """``/healthz`` provider: the fleet is ready while it admits work
@@ -693,5 +882,7 @@ class FleetSupervisor:
                 "jdepth": self.peak_depth,
                 "unacked": audit.unacked,
                 "double_exec": audit.double_exec,
+                "cache_hits": self.result_cache.hits,
+                "cache_hit_rate": self.result_cache.stats()["hit_rate"],
                 "quarantined": [s for s, w in self.workers.items()
                                 if w.quarantined]}

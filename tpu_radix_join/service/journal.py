@@ -43,12 +43,52 @@ JOURNAL_BASENAME = "query_journal.jsonl"
 _KINDS = ("intent", "outcome")
 
 
+#: Request fields that do not change what the query COMPUTES: a deadline
+#: changes when we give up, not the answer; display names are client-side
+#: labels.  Excluded from the fingerprint so equal work dedups even when
+#: clients vary the non-semantic envelope.
+NONSEMANTIC_FIELDS = ("deadline_s", "tenant_name", "display_name")
+
+
+def _canonical(obj, top: bool = False):
+    """Canonical form of one request value: dict keys sorted with the
+    non-semantic envelope dropped at the top level, integral floats
+    folded to int (``2.0`` and ``2`` name the same workload — JSON
+    clients disagree on number types, the query does not), tuples and
+    lists unified."""
+    if isinstance(obj, dict):
+        return {k: _canonical(obj[k]) for k in sorted(obj)
+                if not (top and k in NONSEMANTIC_FIELDS)}
+    if isinstance(obj, bool):          # bool is an int subclass: keep it
+        return obj
+    if isinstance(obj, float) and obj.is_integer():
+        return int(obj)
+    if isinstance(obj, (list, tuple)):
+        return [_canonical(v) for v in obj]
+    return obj
+
+
 def request_fingerprint(request: dict) -> str:
     """Stable identity of one query request: sha256 over the sorted-key
-    JSON of the request fields.  Everything that changes what the query
-    computes is in the request dict, so equal fingerprints mean "the
-    same query" across supervisor incarnations."""
-    blob = json.dumps(request, sort_keys=True, default=str)
+    JSON of the *canonicalized* request fields.  Everything that changes
+    what the query computes is in the request dict, so equal fingerprints
+    mean "the same query" across supervisor incarnations.
+
+    Canonicalization (key order, integral-float folding, non-semantic
+    field exclusion — :func:`_canonical`) means two requests for equal
+    work hash equal even when the JSON lines differ textually.
+
+    Journal compatibility: hardening the canonicalization CHANGED the
+    fingerprint strings for requests carrying floats-with-integral-values
+    or a ``deadline_s``.  A pre-hardening journal replayed under this
+    build simply sees its old fingerprints as distinct cold entries —
+    unacknowledged intents still replay (the fp is read from the intent
+    row, never recomputed against the new scheme mid-replay), and no old
+    fp can collide with a new one, so exactly-once is preserved; only
+    cross-build outcome dedup of textually-divergent duplicates is lost.
+    """
+    blob = json.dumps(_canonical(request, top=True), sort_keys=True,
+                      default=str)
     return hashlib.sha256(blob.encode()).hexdigest()[:16]
 
 

@@ -45,8 +45,9 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from tpu_radix_join.core.config import JoinConfig, ServiceConfig
-from tpu_radix_join.performance.measurements import (COMPILEMS, JHIST,
-                                                     MEPOCH, NCOMPILE,
+from tpu_radix_join.performance.measurements import (BATCHN, BATCHQ,
+                                                     COMPILEMS, DELTAMERGE,
+                                                     JHIST, MEPOCH, NCOMPILE,
                                                      QDEADLINE, QDEGRADED,
                                                      QWARM, RANKLOST,
                                                      RECOVERMS, RECOVERN)
@@ -62,8 +63,6 @@ from tpu_radix_join.service.slo import SLORecorder
 #: failure_class still yields a terminal outcome (the session survives),
 #: but chaos/soak treats this string as an isolation violation
 UNCLASSIFIED = "unclassified"
-
-_PLACE_CACHE_MAX = 8     # placed-relation LRU entries (device memory bound)
 
 
 class BackendUnavailable(ConnectionError):
@@ -85,6 +84,11 @@ class QueryRequest:
     seed: int = 1234
     repeats: int = 1
     deadline_s: Optional[float] = None  # None -> ServiceConfig default
+    #: incremental query: this many NEW tuples per node appended to the
+    #: session-resident inner relation since the last query — served by
+    #: the O(N+Δ) delta-merge fast path when residency is enabled
+    #: (ServiceConfig.resident_budget_bytes > 0), full path otherwise
+    delta_tuples_per_node: int = 0
 
     @classmethod
     def from_json(cls, obj: dict) -> "QueryRequest":
@@ -114,6 +118,10 @@ class QueryOutcome:
     breaker_state: str = "closed"
     detail: str = ""
     bundle: Optional[str] = None    # forensics bundle path, failed queries
+    #: which serving path produced the answer: execute (full engine run),
+    #: cache_hit (result cache, no execution), batched (fused multi-query
+    #: program), delta_merge (O(N+Δ) incremental path)
+    served_by: str = "execute"
 
     def to_json(self) -> dict:
         out = dataclasses.asdict(self)
@@ -205,6 +213,34 @@ class JoinSession:
         self._cpu_engine = None         # built lazily on first open-state query
         self._place_cache: "collections.OrderedDict" = \
             collections.OrderedDict()
+        # ------------------------------------------------ serving fast paths
+        from tpu_radix_join.service.resident import ResidentStateManager
+        from tpu_radix_join.service.resultcache import ResultCache
+        #: whole-query reuse keyed by content fingerprint (tier 1; disabled
+        #: unless ServiceConfig.result_cache_max > 0)
+        self.result_cache = ResultCache(self.service.result_cache_max,
+                                        self.service.result_cache_ttl_s,
+                                        measurements=measurements,
+                                        clock=clock)
+        #: device-resident sorted inner lanes for delta-merge (tier 3;
+        #: disabled unless ServiceConfig.resident_budget_bytes > 0)
+        self.resident = ResidentStateManager(
+            self.service.resident_budget_bytes, measurements=measurements)
+        #: host mirror of each resident lane's key multiset — the exactness
+        #: oracle for incremental queries (base ∪ all absorbed deltas has no
+        #: closed-form expected count once the session has grown it)
+        self._resident_host: Dict = {}
+        #: per-relation incremental-probe state: the outer-spec fingerprint
+        #: the running totals were accumulated under, the running device
+        #: total and host-oracle expected, and the HOST-sorted outer lane
+        #: (the device twin lives in ``self.resident`` under a ("probe",…)
+        #: key so it shares the HBM budget and eviction discipline).  Counts
+        #: over multisets are additive, so while the outer spec is unchanged
+        #: each delta query only counts its Δ — the full-lane probe drops
+        #: off the hot path (ops/merge_delta.delta_merge_increment)
+        self._resident_probe: Dict = {}
+        self.batches_fused = 0          # fused device programs dispatched
+        self.batch_queries_fused = 0    # queries served through them
         self._sampler = None            # attached heartbeat, owned if set
         self._closed = False
         #: recent outcomes only (maxlen = service.outcomes_keep): the SLO
@@ -245,25 +281,375 @@ class JoinSession:
     def run_next(self) -> Optional[QueryOutcome]:
         """Execute the oldest admitted query; None when the queue is
         empty.  The tenant's quota slot is released on every outcome
-        path."""
+        path.  Consults the fast-path tiers in price order: result cache
+        (no execution), delta merge (O(N+Δ)), full engine execution."""
         request = self.queue.pop()
         if request is None:
             return None
         try:
-            return self._execute(request)
+            return self._serve_one(request)
         finally:
             self.queue.done(request)
 
-    def drain(self, on_outcome: Optional[Callable] = None
-              ) -> List[QueryOutcome]:
+    def _serve_one(self, request: QueryRequest) -> QueryOutcome:
+        hit = self.try_cache(request)
+        if hit is not None:
+            return hit
+        if request.delta_tuples_per_node > 0:
+            # incremental query: delta-merge when residency holds the
+            # relation, full re-sort otherwise (budget 0 -> every query
+            # pays the full sort — the A/B baseline posture)
+            return self._execute_delta(request)
+        out = self._execute(request)
+        self._cache_put(request, out)
+        return out
+
+    def drain(self, on_outcome: Optional[Callable] = None,
+              batched: Optional[bool] = None) -> List[QueryOutcome]:
+        """Serve every admitted query.  ``batched`` (default: whether
+        ServiceConfig enables a batch window) groups co-batchable queued
+        queries into fused device programs via :meth:`run_next_batch`."""
+        if batched is None:
+            batched = self.service.batch_window_ms > 0
         outs = []
         while True:
-            out = self.run_next()
-            if out is None:
+            batch = (self.run_next_batch() if batched
+                     else _as_list(self.run_next()))
+            if not batch:
                 return outs
+            for out in batch:
+                outs.append(out)
+                if on_outcome is not None:
+                    on_outcome(out)
+
+    def run_next_batch(self) -> List[QueryOutcome]:
+        """Pop the oldest admitted query PLUS every queued query that can
+        legally share its fused program (same :func:`batch_signature`, up
+        to ``batch_max_queries``) and serve them as one device dispatch.
+        Singletons fall through to the normal serving tiers; [] when the
+        queue is empty."""
+        from tpu_radix_join.service.microbatch import batch_signature
+        first = self.queue.pop()
+        if first is None:
+            return []
+        group = [first]
+        try:
+            if (self.service.batch_window_ms > 0
+                    and first.delta_tuples_per_node == 0):
+                sig = batch_signature(first)
+                group += self.queue.pop_matching(
+                    lambda r: (batch_signature(r) == sig
+                               and r.delta_tuples_per_node == 0),
+                    self.service.batch_max_queries - 1)
+            if len(group) == 1:
+                return [self._serve_one(first)]
+            return self._execute_batched(group)
+        finally:
+            for request in group:
+                self.queue.done(request)
+
+    # ----------------------------------------------------- result cache tier
+    def _epoch(self) -> Optional[int]:
+        return self.membership.epoch if self.membership is not None else None
+
+    def _content_fp(self, request: QueryRequest) -> str:
+        from tpu_radix_join.service.resultcache import content_fingerprint
+        return content_fingerprint(
+            request, config_fp=dataclasses.asdict(self.config),
+            epoch=self._epoch())
+
+    def try_cache(self, request: QueryRequest) -> Optional[QueryOutcome]:
+        """Serve ``request`` from the result cache without executing, or
+        None on a miss.  Public so callers (the serve loop, the fleet
+        supervisor) can short-circuit BEFORE admission — a hit never
+        occupies a queue slot or a tenant quota.  Incremental queries
+        never cache-serve: their answer depends on session-grown state,
+        not the request alone."""
+        if (self.result_cache.max_entries == 0
+                or request.delta_tuples_per_node > 0):
+            return None
+        t0 = time.perf_counter()
+        payload = self.result_cache.get(self._content_fp(request),
+                                        epoch=self._epoch())
+        if payload is None:
+            return None
+        out = QueryOutcome(
+            query_id=request.query_id, tenant=request.tenant,
+            status="ok", failure_class=OK,
+            latency_ms=(time.perf_counter() - t0) * 1e3,
+            matches=payload.get("matches"), expected=payload.get("expected"),
+            engine=payload.get("engine", "primary"),
+            warm=True, breaker_state=self.breaker.state,
+            detail="result cache hit", served_by="cache_hit")
+        self.slo.record(request.tenant, out.latency_ms, ok=True)
+        self.outcomes.append(out)
+        return out
+
+    def _cache_put(self, request: QueryRequest, out: QueryOutcome) -> None:
+        """Store one freshly-executed outcome for future content hits —
+        only clean primary successes (a degraded or failed answer is
+        evidence about THIS attempt, not the content)."""
+        if (self.result_cache.max_entries == 0
+                or request.delta_tuples_per_node > 0
+                or out.status != "ok" or out.degraded
+                or out.matches is None):
+            return
+        self.result_cache.put(
+            self._content_fp(request),
+            {"matches": out.matches, "expected": out.expected,
+             "engine": out.engine},
+            epoch=self._epoch())
+
+    # ------------------------------------------------------ micro-batch tier
+    def _host_lanes(self, request: QueryRequest):
+        """Host key lanes + exact expected count for one request's
+        workload — the serving fast paths run on key lanes through one
+        fused program, not the full distributed pipeline, so generation
+        stays on host (data/relation.py's bit-identical numpy path)."""
+        from tpu_radix_join.data.relation import host_join_count
+        inner, outer, expected = self._relations(request)
+        r_keys = inner.fill_np(0, inner.global_size)[0]
+        s_keys = outer.fill_np(0, outer.global_size)[0]
+        if expected is None:
+            expected = host_join_count(r_keys, s_keys)
+        return r_keys, s_keys, expected, max(inner.key_bound(),
+                                             outer.key_bound())
+
+    def _execute_batched(self, group: List[QueryRequest]
+                         ) -> List[QueryOutcome]:
+        """Serve ``group`` (>= 2 same-signature queries) through ONE fused
+        device program (ops/merge_delta.batched_merge_count): Q dispatch
+        floors collapse to one, per-query counts stay exact via the
+        composite query tag.  Failure isolation: ANY error inside the
+        fused path retries the whole group unbatched, one query at a
+        time, so a poisoned query classifies alone and its batch-mates
+        still succeed."""
+        import numpy as np
+
+        from tpu_radix_join.ops.merge_delta import (batch_feasible,
+                                                    compiled_batched_merge_count)
+        m = self.measurements
+        svc = self.service
+        t0 = time.perf_counter()
+        try:
+            lanes = [self._host_lanes(r) for r in group]
+            key_bound = max(kb for _, _, _, kb in lanes)
+            if not batch_feasible(len(group), key_bound):
+                raise ValueError(
+                    f"batch of {len(group)} at key_bound {key_bound} "
+                    f"overflows the composite word")
+            deadlines = []
+            for request in group:
+                budget = (request.deadline_s if request.deadline_s is not None
+                          else svc.default_deadline_s)
+                deadline = Deadline(budget, clock=self._clock)
+                deadline.check("admitted")
+                deadlines.append(deadline)
+            r_sizes = tuple(int(rk.shape[0]) for rk, _, _, _ in lanes)
+            s_sizes = tuple(int(sk.shape[0]) for _, sk, _, _ in lanes)
+            import jax.numpy as jnp
+            fn = compiled_batched_merge_count(r_sizes, s_sizes, key_bound)
+            r_cat = jnp.asarray(np.concatenate([rk for rk, _, _, _ in lanes]))
+            s_cat = jnp.asarray(np.concatenate([sk for _, sk, _, _ in lanes]))
+            for _ in range(max(1, group[0].repeats)):
+                counts = fn(r_cat, s_cat)
+            counts = np.asarray(counts)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            if m is not None:
+                m.event("batch_fallback", size=len(group),
+                        error=repr(e)[:200])
+            return [self._serve_one(r) for r in group]
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        self.batches_fused += 1
+        self.batch_queries_fused += len(group)
+        if m is not None:
+            m.incr(BATCHN)
+            m.incr(BATCHQ, len(group))
+        outs = []
+        for request, (_, _, expected, _), deadline, n in zip(
+                group, lanes, deadlines, counts):
+            status, cls, detail = "ok", OK, f"fused batch of {len(group)}"
+            try:
+                deadline.check("batched")
+            except DeadlineExceeded as e:
+                status, cls, detail = "failed", DEADLINE_EXCEEDED, str(e)
+                if m is not None:
+                    m.incr(QDEADLINE)
+            out = QueryOutcome(
+                query_id=request.query_id, tenant=request.tenant,
+                status=status, failure_class=cls, latency_ms=latency_ms,
+                matches=int(n), expected=int(expected),
+                breaker_state=self.breaker.state, detail=detail,
+                served_by="batched")
+            self.slo.record(request.tenant, latency_ms,
+                            ok=(status == "ok"),
+                            failure_class=None if cls == OK else cls)
+            self.outcomes.append(out)
+            if status == "ok":
+                self._cache_put(request, out)
             outs.append(out)
-            if on_outcome is not None:
-                on_outcome(out)
+        return outs
+
+    # ------------------------------------------------------ delta-merge tier
+    def _delta_keys(self, start: int, count: int, seed: int):
+        """The Δ new inner keys appended at mirror length ``start`` —
+        fresh keys in [start, start+count), deterministically shuffled,
+        disjoint from everything the resident union already holds (the
+        base is a unique permutation of [0, N), deltas extend it)."""
+        import numpy as np
+
+        from tpu_radix_join.ops.merge_delta import MAX_SERVE_KEY
+        if start + count > MAX_SERVE_KEY:
+            raise ValueError(
+                f"resident union would reach {start + count}, past the "
+                f"presorted-probe key ceiling {MAX_SERVE_KEY}")
+        keys = np.arange(start, start + count, dtype=np.uint32)
+        np.random.default_rng(seed + start).shuffle(keys)
+        return keys
+
+    def _execute_delta(self, request: QueryRequest) -> QueryOutcome:
+        """Serve one incremental query: sort only the Δ delta lane, merge
+        it into the device-resident sorted union, probe — O(N+Δ) instead
+        of a full re-sort (served_by="delta_merge").  A cold relation
+        (first sight, or evicted under the HBM budget) pays one full sort
+        and seeds residency for the next delta (served_by="execute")."""
+        import numpy as np
+
+        import jax.numpy as jnp
+        from tpu_radix_join.data.relation import host_join_count
+        from tpu_radix_join.ops.merge_count import (merge_count_presorted,
+                                                    presort_keys)
+        from tpu_radix_join.ops.merge_delta import (
+            compiled_delta_merge_count, compiled_delta_merge_increment)
+        m = self.measurements
+        svc = self.service
+        t0 = time.perf_counter()
+        status, cls, detail, served_by = "ok", OK, "", "execute"
+        matches = expected = None
+        try:
+            budget = (request.deadline_s if request.deadline_s is not None
+                      else svc.default_deadline_s)
+            deadline = Deadline(budget, clock=self._clock)
+            deadline.check("admitted")
+            inner, outer, _ = self._relations(request)
+            nodes = self.config.num_nodes
+            delta_n = request.delta_tuples_per_node * nodes
+            rkey = ("delta", inner.global_size, request.seed,
+                    request.tuples_per_node)
+            epoch = self._epoch()
+            rprobe = ("probe", inner.global_size, request.seed,
+                      request.tuples_per_node)
+            outer_fp = (request.outer_kind, request.modulo,
+                        request.zipf_theta, request.repeats,
+                        outer.global_size)
+            lane = self.resident.get(rkey, epoch)
+            mirror = self._resident_host.get(rkey)
+            if lane is None and mirror is not None:
+                # lane evicted under the byte budget but the host mirror
+                # survives: rebuild residency with one full sort (and drop
+                # the running probe totals — they describe the grown union)
+                mirror = None
+                self._resident_host.pop(rkey, None)
+                self._resident_probe.pop(rkey, None)
+            base_len = len(mirror) if mirror is not None else inner.global_size
+            delta_np = self._delta_keys(base_len, delta_n, request.seed)
+            s_keys = outer.fill_np(0, outer.global_size)[0]
+            deadline.check("generated")
+            seed_probe = True
+            if lane is None:
+                base_np = inner.fill_np(0, inner.global_size)[0]
+                mirror = np.concatenate([base_np, delta_np])
+                union = presort_keys(jnp.asarray(mirror))
+                matches = int(merge_count_presorted(union,
+                                                    jnp.asarray(s_keys)))
+                expected = host_join_count(mirror, s_keys)
+                detail = "cold relation: full sort seeded residency"
+            else:
+                mirror = np.concatenate([mirror, delta_np])
+                probe = self._resident_probe.get(rkey)
+                s_lane = self.resident.get(rprobe, epoch)
+                if (probe is not None and probe["outer_fp"] == outer_fp
+                        and probe["union_len"] == base_len
+                        and s_lane is not None):
+                    # unchanged outer: probe ONLY the Δ against the
+                    # resident sorted outer lane; totals are additive over
+                    # the multiset union, so the M·log N full-lane probe
+                    # (as costly as the re-sort it replaced) never runs
+                    fn = compiled_delta_merge_increment(
+                        int(lane.shape[0]), int(delta_np.shape[0]),
+                        int(s_lane.shape[0]))
+                    union, inc = fn(lane, jnp.asarray(delta_np), s_lane)
+                    matches = probe["total"] + int(inc)
+                    # host oracle stays independent of the device path:
+                    # numpy binary search of the Δ in the HOST-sorted outer
+                    ds = np.sort(delta_np)
+                    sh = probe["s_sorted_host"]
+                    expected = probe["expected"] + int(
+                        (np.searchsorted(sh, ds, side="right")
+                         - np.searchsorted(sh, ds, side="left")).sum())
+                    seed_probe = False
+                    detail = ("incremental probe: Δ counted against the "
+                              "resident sorted outer lane")
+                else:
+                    fn = compiled_delta_merge_count(int(lane.shape[0]),
+                                                    int(delta_np.shape[0]),
+                                                    int(s_keys.shape[0]))
+                    union, total = fn(lane, jnp.asarray(delta_np),
+                                      jnp.asarray(s_keys))
+                    matches = int(total)
+                    expected = host_join_count(mirror, s_keys)
+                self.resident.note_merge(rkey)
+                served_by = "delta_merge"
+                if m is not None:
+                    m.incr(DELTAMERGE)
+            deadline.check("merged")
+            self.resident.put(rkey, union, epoch)
+            self._resident_host[rkey] = mirror
+            if seed_probe and self.resident.budget_bytes:
+                # (re)seed the incremental-probe state under the same HBM
+                # budget; when the outer lane is not admitted (budget too
+                # tight) the next query simply pays the full probe.  With
+                # residency disabled entirely (budget 0) we must not even
+                # sort the outer here — that would tax the full-re-sort
+                # baseline with work only the resident tier can use
+                s_lane = presort_keys(jnp.asarray(s_keys))
+                if self.resident.put(rprobe, s_lane, epoch):
+                    self._resident_probe[rkey] = {
+                        "outer_fp": outer_fp, "union_len": len(mirror),
+                        "total": matches, "expected": expected,
+                        "s_sorted_host": np.sort(s_keys)}
+                else:
+                    self._resident_probe.pop(rkey, None)
+            elif not seed_probe:
+                probe["union_len"] = len(mirror)
+                probe["total"] = matches
+                probe["expected"] = expected
+        except DeadlineExceeded as e:
+            status, cls, detail = "failed", DEADLINE_EXCEEDED, str(e)
+            if m is not None:
+                m.incr(QDEADLINE)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as e:           # noqa: BLE001 — isolation boundary
+            status = "failed"
+            cls = getattr(e, "failure_class", None) or UNCLASSIFIED
+            detail = repr(e)[:500]
+            if m is not None:
+                m.event("query_failed", query_id=request.query_id,
+                        failure_class=cls, error=repr(e)[:200])
+        latency_ms = (time.perf_counter() - t0) * 1e3
+        out = QueryOutcome(
+            query_id=request.query_id, tenant=request.tenant,
+            status=status, failure_class=cls, latency_ms=latency_ms,
+            matches=matches, expected=expected,
+            breaker_state=self.breaker.state, detail=detail,
+            served_by=served_by)
+        self.slo.record(request.tenant, latency_ms, ok=(status == "ok"),
+                        failure_class=None if cls == OK else cls)
+        self.outcomes.append(out)
+        return out
 
     # ------------------------------------------------------------ internals
     def _wire_elastic(self, engine) -> None:
@@ -324,9 +710,20 @@ class JoinSession:
             return self._place_cache[key]
         batch = engine.place(rel)
         self._place_cache[key] = batch
-        while len(self._place_cache) > _PLACE_CACHE_MAX:
+        while len(self._place_cache) > self.service.place_cache_max:
             self._place_cache.popitem(last=False)
         return batch
+
+    def placed_bytes(self) -> int:
+        """Device bytes held by the placed-relation LRU (key + rid + wide
+        lanes of every cached batch) — the heartbeat/statusz gauge that
+        makes the ``place_cache_max`` knob observable."""
+        total = 0
+        for batch in self._place_cache.values():
+            for lane in batch:
+                if lane is not None and hasattr(lane, "nbytes"):
+                    total += int(lane.nbytes)
+        return total
 
     def _execute(self, request: QueryRequest) -> QueryOutcome:
         m = self.measurements
@@ -534,10 +931,27 @@ class JoinSession:
         self._sampler.start()
         return self._sampler
 
+    def fastpath_stats(self) -> dict:
+        """Live fast-path state for ``/statusz``: result-cache hit rates,
+        residency bytes, and fused-batch totals (the serve loop's
+        MicroBatcher contributes window occupancy on top)."""
+        return {"cache": self.result_cache.stats(),
+                "resident": self.resident.stats(),
+                "batch": {"fused_batches": self.batches_fused,
+                          "fused_queries": self.batch_queries_fused},
+                "placed_bytes": self.placed_bytes(),
+                "place_cache_entries": len(self._place_cache),
+                "place_cache_max": self.service.place_cache_max}
+
     def _heartbeat_extra(self) -> dict:
         out = {"slo": self.slo.snapshot(),
                "breaker": self.breaker.snapshot(),
-               "queue_depth": self.queue.depth()}
+               "queue_depth": self.queue.depth(),
+               "placed_bytes": self.placed_bytes()}
+        if self.result_cache.max_entries:
+            out["result_cache"] = self.result_cache.stats()
+        if self.resident.budget_bytes:
+            out["resident"] = self.resident.stats()
         if self.membership is not None:
             out["membership"] = {"epoch": self.membership.epoch,
                                  "lost": sorted(self.membership.lost),
@@ -550,7 +964,19 @@ class JoinSession:
         out.update(breaker_state=self.breaker.state,
                    breaker_trips=self.breaker.trips,
                    breaker_probes=self.breaker.probes,
-                   queue_rejected=self.queue.rejected)
+                   queue_rejected=self.queue.rejected,
+                   placed_bytes=self.placed_bytes())
+        if self.result_cache.max_entries:
+            cache = self.result_cache.stats()
+            out["cache_hits"] = cache["hits"]
+            out["cache_hit_rate"] = cache["hit_rate"]
+        if self.batches_fused:
+            out["fused_batches"] = self.batches_fused
+            out["fused_queries"] = self.batch_queries_fused
+        if self.resident.budget_bytes:
+            res = self.resident.stats()
+            out["resident_bytes"] = res["resident_bytes"]
+            out["delta_merges"] = res["merges"]
         m = self.measurements
         if m is not None:
             out["warm_queries"] = int(m.counters.get(QWARM, 0))
@@ -576,6 +1002,10 @@ class JoinSession:
             self._sampler.stop()
             self._sampler = None
         self._place_cache.clear()
+        self.result_cache.invalidate()
+        self.resident.invalidate()
+        self._resident_host.clear()
+        self._resident_probe.clear()
         for eng in (self.engine, self._cpu_engine):
             if eng is not None:
                 eng._compiled.clear()
@@ -594,3 +1024,7 @@ class JoinSession:
 def _null_ctx():
     import contextlib
     return contextlib.nullcontext()
+
+
+def _as_list(out: Optional[QueryOutcome]) -> List[QueryOutcome]:
+    return [out] if out is not None else []
